@@ -1,0 +1,28 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A brand-new framework with the capability surface of Deeplearning4j
+(builder-style configuration -> init() -> fit()/output()/evaluate(), a full
+layer zoo, DAG computation graphs, updaters, listeners, early stopping,
+checkpointing, Keras import, embeddings, and distributed training), designed
+idiomatically for TPUs on JAX/XLA/Pallas:
+
+- configs are pure data (dataclasses with JSON round-trip),
+- parameters and optimizer state are pytrees,
+- ``fit()`` compiles ONE jitted train step (forward + backward + update fused
+  into a single XLA program),
+- device-side loops (LSTM time steps) are ``lax.scan``,
+- parallelism is expressed as shardings over a ``jax.sharding.Mesh`` with XLA
+  collectives over ICI/DCN (replacing ParallelWrapper / Spark parameter
+  averaging / Aeron in the reference).
+
+Reference capability map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ops import activations, losses, initializers
+from deeplearning4j_tpu.nn.conf import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

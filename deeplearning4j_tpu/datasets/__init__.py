@@ -1,0 +1,11 @@
+"""Host-side data pipeline (parity: deeplearning4j-nn/.../datasets/iterator
++ deeplearning4j-core dataset fetchers, SURVEY.md §2.5)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    DataSetIterator,
+    ListDataSetIterator,
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+)

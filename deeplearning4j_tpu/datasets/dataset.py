@@ -1,0 +1,63 @@
+"""DataSet: one (features, labels) minibatch, with optional masks.
+
+Parity: ND4J's DataSet as consumed by the reference
+(`org.nd4j.linalg.dataset.DataSet`, used via DataSetIterator 23x in
+deeplearning4j-nn). Masks follow the reference's time-series semantics:
+features_mask/labels_mask are [batch, time] 0/1 arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, num_train: int):
+        a = DataSet(
+            self.features[:num_train],
+            None if self.labels is None else self.labels[:num_train],
+            None if self.features_mask is None else self.features_mask[:num_train],
+            None if self.labels_mask is None else self.labels_mask[:num_train],
+        )
+        b = DataSet(
+            self.features[num_train:],
+            None if self.labels is None else self.labels[num_train:],
+            None if self.features_mask is None else self.features_mask[num_train:],
+            None if self.labels_mask is None else self.labels_mask[num_train:],
+        )
+        return a, b
+
+    def shuffle(self, seed: int = 0):
+        perm = np.random.default_rng(seed).permutation(self.num_examples)
+        return DataSet(
+            self.features[perm],
+            None if self.labels is None else self.labels[perm],
+            None if self.features_mask is None else self.features_mask[perm],
+            None if self.labels_mask is None else self.labels_mask[perm],
+        )
+
+    @staticmethod
+    def merge(datasets):
+        def cat(xs):
+            if any(x is None for x in xs):
+                return None
+            return np.concatenate(xs, axis=0)
+        return DataSet(
+            cat([d.features for d in datasets]),
+            cat([d.labels for d in datasets]),
+            cat([d.features_mask for d in datasets]),
+            cat([d.labels_mask for d in datasets]),
+        )

@@ -1,0 +1,174 @@
+"""DataSetIterator infrastructure.
+
+Parity: the reference's iterator API + async prefetch wrappers
+(deeplearning4j-nn/.../datasets/iterator/: AsyncDataSetIterator — a
+background prefetch thread with a queue of 2, auto-wrapped at
+MultiLayerNetwork.java:951; MultipleEpochsIterator; adapters). The async
+wrapper here overlaps host-side batch preparation with device compute — the
+TPU equivalent of the reference's host I/O boundary.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol: iterate DataSets; ``reset()`` restarts."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    @property
+    def batch_size(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterates a pre-built list of DataSet minibatches
+    (ListDataSetIterator parity)."""
+
+    def __init__(self, datasets: List[DataSet]):
+        self._datasets = list(datasets)
+
+    def __iter__(self):
+        return iter(self._datasets)
+
+    def __len__(self):
+        return len(self._datasets)
+
+    @property
+    def batch_size(self):
+        return self._datasets[0].num_examples if self._datasets else None
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Slices (features, labels) arrays into minibatches, optionally
+    reshuffling each epoch (the canonical in-memory path; parity with
+    the reference's INDArrayDataSetIterator)."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels) if labels is not None else None
+        self._batch = int(batch_size)
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        stop = (n // self._batch) * self._batch if self._drop_last else n
+        for start in range(0, stop, self._batch):
+            sel = idx[start:start + self._batch]
+            yield DataSet(
+                self.features[sel],
+                None if self.labels is None else self.labels[sel],
+            )
+
+    def __len__(self):
+        n = self.features.shape[0]
+        return n // self._batch if self._drop_last else -(-n // self._batch)
+
+    @property
+    def batch_size(self):
+        return self._batch
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (AsyncDataSetIterator.java parity:
+    blocking queue, default depth 2)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 2):
+        self.base = base
+        self.queue_size = queue_size
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
+        error: list = []
+
+        def put(item) -> bool:
+            # Bounded put that gives up when the consumer abandoned the
+            # generator (e.g. an exception in the training loop) — otherwise
+            # the producer would block forever on a full queue.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for ds in self.base:
+                    if not put(ds):
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                error.append(e)
+            finally:
+                put(self._SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    if error:
+                        raise error[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays a base iterator for N epochs (MultipleEpochsIterator parity)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Adapts a plain python iterable of DataSets (IteratorDataSetIterator
+    parity)."""
+
+    def __init__(self, iterable_factory):
+        # factory so reset() can re-create the underlying iterable
+        self._factory = iterable_factory
+
+    def __iter__(self):
+        return iter(self._factory())

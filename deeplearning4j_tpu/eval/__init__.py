@@ -1,0 +1,7 @@
+"""Evaluation suite (parity: deeplearning4j-nn/.../eval — Evaluation.java,
+ROC.java, RegressionEvaluation.java, EvaluationBinary.java, ConfusionMatrix)."""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.eval.binary import EvaluationBinary

@@ -1,0 +1,145 @@
+"""Classification evaluation.
+
+Parity: eval/Evaluation.java (1,110 LoC; eval() :195, f1() :667,
+accuracy() :681, ConfusionMatrix). Accumulation happens host-side in numpy
+(cheap) over device-computed predictions; metrics match the reference's
+definitions (per-class precision/recall/F1; macro-averaged f1(); micro
+accuracy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray):
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def actual_total(self, cls: int) -> int:
+        return int(self.matrix[cls].sum())
+
+    def predicted_total(self, cls: int) -> int:
+        return int(self.matrix[:, cls].sum())
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+class Evaluation:
+    def __init__(self, num_classes: int | None = None, labels: list | None = None):
+        self.class_names = labels
+        self.num_classes = num_classes if num_classes else (
+            len(labels) if labels else None)
+        self.confusion: ConfusionMatrix | None = None
+        if self.num_classes:
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    # ------------------------------------------------------------------ eval
+    def eval(self, labels, predictions, mask=None):
+        """Accumulate a batch. ``labels`` one-hot (or class indices),
+        ``predictions`` probabilities/scores [batch(, time), classes]."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if predictions.ndim == 3:  # time series -> flatten (mask-aware)
+            b, t, c = predictions.shape
+            predictions = predictions.reshape(b * t, c)
+            labels = labels.reshape(b * t, -1)
+            if mask is not None:
+                m = np.asarray(mask).reshape(b * t).astype(bool)
+                predictions, labels = predictions[m], labels[m]
+        elif mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            predictions, labels = predictions[m], labels[m]
+        if labels.ndim == 2 and labels.shape[1] > 1:
+            actual = labels.argmax(axis=1)
+            ncls = labels.shape[1]
+        else:
+            actual = labels.reshape(-1).astype(int)
+            ncls = predictions.shape[1]
+        predicted = predictions.argmax(axis=1)
+        if self.confusion is None:
+            self.num_classes = ncls
+            self.confusion = ConfusionMatrix(ncls)
+        self.confusion.add(actual, predicted)
+
+    # --------------------------------------------------------------- metrics
+    def _tp(self, c):
+        return self.confusion.get_count(c, c)
+
+    def _fp(self, c):
+        return self.confusion.predicted_total(c) - self._tp(c)
+
+    def _fn(self, c):
+        return self.confusion.actual_total(c) - self._tp(c)
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def precision(self, cls: int | None = None) -> float:
+        if cls is not None:
+            denom = self._tp(cls) + self._fp(cls)
+            return self._tp(cls) / denom if denom else 0.0
+        vals = [self.precision(c) for c in range(self.num_classes)
+                if self.confusion.actual_total(c) > 0 or
+                self.confusion.predicted_total(c) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: int | None = None) -> float:
+        if cls is not None:
+            denom = self._tp(cls) + self._fn(cls)
+            return self._tp(cls) / denom if denom else 0.0
+        vals = [self.recall(c) for c in range(self.num_classes)
+                if self.confusion.actual_total(c) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: int | None = None) -> float:
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        p, r = self.precision(), self.recall()
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        fp = self._fp(cls)
+        tn = self.confusion.matrix.sum() - self.confusion.actual_total(cls) - fp
+        return fp / (fp + tn) if (fp + tn) else 0.0
+
+    def matthews_correlation(self, cls: int) -> float:
+        tp, fp, fn = self._tp(cls), self._fp(cls), self._fn(cls)
+        tn = self.confusion.matrix.sum() - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / denom) if denom else 0.0
+
+    def stats(self) -> str:
+        lines = ["", "========================Evaluation Metrics========================"]
+        lines.append(f" # of classes: {self.num_classes}")
+        lines.append(f" Accuracy:  {self.accuracy():.4f}")
+        lines.append(f" Precision: {self.precision():.4f}")
+        lines.append(f" Recall:    {self.recall():.4f}")
+        lines.append(f" F1 Score:  {self.f1():.4f}")
+        lines.append("")
+        lines.append("=========================Confusion Matrix=========================")
+        lines.append(str(self.confusion))
+        lines.append("==================================================================")
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation"):
+        """Combine accumulators (distributed eval reduction parity:
+        spark IEvaluateFlatMapFunction result merging)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        self.confusion.matrix += other.confusion.matrix
+        return self

@@ -1,0 +1,1 @@
+"""Neural-network core (the TPU-native equivalent of deeplearning4j-nn)."""

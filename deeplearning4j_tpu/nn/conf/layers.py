@@ -1,0 +1,205 @@
+"""Layer configuration classes.
+
+Parity target: the reference's 28 config classes in
+deeplearning4j-nn/.../nn/conf/layers/ (SURVEY.md §2.1). Each config is a
+frozen dataclass registered by ``layer_type`` (for JSON round-trip) and knows
+how to (a) infer its n_in from an InputType, (b) compute its output
+InputType, and (c) instantiate its runtime layer.
+
+TPU-native notes: conv/pool layers run NHWC (TPU-preferred layout; the
+reference is NCHW — handled at the API boundary, not in the kernels);
+recurrent layers run [batch, time, features] and lower to lax.scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.updater import Updater, updater_from_dict
+
+LAYER_REGISTRY: dict[str, type] = {}
+
+
+def register_layer(cls):
+    LAYER_REGISTRY[cls.layer_type] = cls
+    return cls
+
+
+def _encode(v):
+    if isinstance(v, Updater):
+        return v.to_dict()
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def layer_to_dict(layer: "BaseLayerConfig") -> dict:
+    d = {}
+    for f in dataclasses.fields(layer):
+        v = getattr(layer, f.name)
+        if v is None:
+            continue
+        d[f.name] = _encode(v)
+    d["layer_type"] = layer.layer_type
+    return d
+
+
+def layer_from_dict(d: dict) -> "BaseLayerConfig":
+    d = dict(d)
+    ltype = d.pop("layer_type")
+    cls = LAYER_REGISTRY[ltype]
+    if "updater" in d and isinstance(d["updater"], dict):
+        d["updater"] = updater_from_dict(d["updater"])
+    fields = {f.name for f in dataclasses.fields(cls)}
+    # tuple-valued fields arrive as lists from JSON
+    for k, v in list(d.items()):
+        if isinstance(v, list) and k in fields:
+            d[k] = tuple(v)
+    return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclass(frozen=True)
+class BaseLayerConfig:
+    """Common per-layer hyperparameters. ``None`` means "inherit from the
+    global NeuralNetConfiguration" (mirroring the reference's
+    Layer/NeuralNetConfiguration override semantics)."""
+
+    layer_type = "base"
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[Any] = None     # str name or distribution dict
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout: Optional[float] = None       # drop probability (0 disables).
+    updater: Optional[Updater] = None     # per-layer optimizer override
+    learning_rate: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    # -- shape inference ---------------------------------------------------
+    def with_n_in(self, input_type: InputType) -> "BaseLayerConfig":
+        """Return a copy with n_in (etc.) inferred from the input type."""
+        return self
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # -- runtime -----------------------------------------------------------
+    def make_layer(self, input_type: InputType, global_conf, policy):
+        raise NotImplementedError
+
+    def has_params(self) -> bool:
+        return False
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class FeedForwardLayerConfig(BaseLayerConfig):
+    """Base for layers with (n_in, n_out) dense-style params
+    (FeedForwardLayer.java parity)."""
+
+    layer_type = "feed_forward"
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+
+    def with_n_in(self, input_type: InputType) -> "FeedForwardLayerConfig":
+        if self.n_in is None:
+            return self.replace(n_in=input_type.flat_size())
+        return self
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def has_params(self) -> bool:
+        return True
+
+
+@register_layer
+@dataclass(frozen=True)
+class Dense(FeedForwardLayerConfig):
+    """Fully connected layer (DenseLayer.java parity)."""
+
+    layer_type = "dense"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+        return DenseLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class Output(FeedForwardLayerConfig):
+    """Dense + loss head (OutputLayer.java parity). ``loss`` names an
+    ops.losses entry; the loss gradient flows via autodiff rather than the
+    reference's ILossFunction.computeGradient."""
+
+    layer_type = "output"
+    loss: str = "mcxent"
+    has_bias: bool = True
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+        return OutputLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class LossLayer(BaseLayerConfig):
+    """Loss-only head without params (LossLayer.java parity)."""
+
+    layer_type = "loss"
+    loss: str = "mcxent"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.feedforward import LossOnlyLayer
+        return LossOnlyLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class ActivationLayer(BaseLayerConfig):
+    """Standalone activation (ActivationLayer.java parity)."""
+
+    layer_type = "activation"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.feedforward import ActivationOnlyLayer
+        return ActivationOnlyLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class Dropout(BaseLayerConfig):
+    """Standalone dropout layer (DropoutLayer.java parity). The per-layer
+    ``dropout`` field on other layers applies dropout to their *input*,
+    mirroring the reference's conf.dropOut semantics."""
+
+    layer_type = "dropout"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.feedforward import DropoutOnlyLayer
+        return DropoutOnlyLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class Embedding(FeedForwardLayerConfig):
+    """Integer-index embedding lookup (EmbeddingLayer.java parity — the
+    reference implements it as a one-hot mmul shortcut; on TPU it is a
+    jnp.take gather, which XLA lowers to an efficient dynamic-gather)."""
+
+    layer_type = "embedding"
+    has_bias: bool = True
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.feedforward import EmbeddingLayerImpl
+        return EmbeddingLayerImpl(self, input_type, global_conf, policy)

@@ -1,0 +1,153 @@
+"""Input preprocessors — pure shape/layout adapters between layer families.
+
+Parity: nn/conf/preprocessor/ in the reference (CnnToFeedForward,
+FeedForwardToCnn, CnnToRnn, RnnToCnn, FeedForwardToRnn, RnnToFeedForward —
+SURVEY.md §2.3). In the reference these carry hand-written backprop; here
+they are pure jnp reshapes, so autodiff derives the backward pass.
+
+Layout note (TPU-native): convolutional tensors are NHWC (the reference is
+NCHW); recurrent tensors are [batch, time, features] (the reference is
+[batch, features, time]). The preprocessors below speak the TPU layouts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+PREPROCESSOR_REGISTRY: dict[str, type] = {}
+
+
+def register_preprocessor(cls):
+    PREPROCESSOR_REGISTRY[cls.kind] = cls
+    return cls
+
+
+def preprocessor_to_dict(p):
+    d = {k: v for k, v in p.__dict__.items()} if not hasattr(p, "__dataclass_fields__") else {
+        f: getattr(p, f) for f in p.__dataclass_fields__}
+    d["kind"] = p.kind
+    return d
+
+
+def preprocessor_from_dict(d):
+    d = dict(d)
+    kind = d.pop("kind")
+    return PREPROCESSOR_REGISTRY[kind](**d)
+
+
+@dataclass(frozen=True)
+class InputPreProcessor:
+    kind = "identity"
+
+    def __call__(self, x):
+        return x
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class CnnToFeedForward(InputPreProcessor):
+    """[b, h, w, c] -> [b, h*w*c] (CnnToFeedForwardPreProcessor.java parity)."""
+
+    kind = "cnn_to_ff"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(
+            input_type.height * input_type.width * input_type.channels)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class FeedForwardToCnn(InputPreProcessor):
+    """[b, h*w*c] -> [b, h, w, c] (FeedForwardToCnnPreProcessor.java parity)."""
+
+    kind = "ff_to_cnn"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class RnnToFeedForward(InputPreProcessor):
+    """[b, t, f] -> [b*t, f] (RnnToFeedForwardPreProcessor.java parity)."""
+
+    kind = "rnn_to_ff"
+
+    def __call__(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class FeedForwardToRnn(InputPreProcessor):
+    """[b*t, f] -> [b, t, f]; needs the time length at call time, so it takes
+    it from the configured ``timesteps`` (FeedForwardToRnnPreProcessor.java
+    parity)."""
+
+    kind = "ff_to_rnn"
+    timesteps: int = 0
+
+    def __call__(self, x):
+        return x.reshape(-1, self.timesteps, x.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size(), self.timesteps)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class CnnToRnn(InputPreProcessor):
+    """[b, h, w, c] -> [b, t, h*w*c/t]? — the reference treats each example's
+    flattened CNN activations as one timestep per batch entry is NOT what it
+    does; it maps [b*t, h, w, c] -> [b, t, h*w*c]. We mirror that."""
+
+    kind = "cnn_to_rnn"
+    timesteps: int = 0
+
+    def __call__(self, x):
+        flat = x.reshape(x.shape[0], -1)
+        return flat.reshape(-1, self.timesteps, flat.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(
+            input_type.height * input_type.width * input_type.channels,
+            self.timesteps)
+
+
+@register_preprocessor
+@dataclass(frozen=True)
+class RnnToCnn(InputPreProcessor):
+    """[b, t, h*w*c] -> [b*t, h, w, c] (RnnToCnnPreProcessor.java parity)."""
+
+    kind = "rnn_to_cnn"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)

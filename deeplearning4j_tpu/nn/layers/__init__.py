@@ -1,0 +1,11 @@
+"""Runtime layer implementations (parity: deeplearning4j-nn/.../nn/layers/).
+
+Layers here are *functional*: they hold config + shapes only; parameters and
+mutable state (e.g. batch-norm running stats) live in pytrees owned by the
+network and are passed through ``apply``. This replaces the reference's
+stateful layer objects holding views into one flat param vector
+(MultiLayerNetwork.java:903-906) — XLA's fusion makes the contiguous-buffer
+trick obsolete (SURVEY.md §7).
+"""
+
+from deeplearning4j_tpu.nn.layers.base import Layer

@@ -1,0 +1,102 @@
+"""Base runtime layer.
+
+Reference seam: nn/api/Layer.java (activate :165-202, backpropGradient :119)
+and nn/layers/BaseLayer.java. Backprop is derived by JAX autodiff of
+``apply``, so only the forward pass is written by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import activations as activations_mod
+
+
+class Layer:
+    """Functional runtime layer.
+
+    Lifecycle: constructed from (config, input_type, global_conf, policy);
+    ``init_params(key)`` returns this layer's param subtree; ``apply(params,
+    state, x, train=..., rng=...)`` returns ``(output, new_state)``.
+    """
+
+    def __init__(self, conf, input_type, global_conf, policy):
+        self.conf = conf
+        self.input_type = input_type
+        self.global_conf = global_conf
+        self.policy = policy
+        self.output_type = conf.get_output_type(input_type)
+
+    # ---- config resolution (layer overrides global) -----------------------
+    def resolve(self, name, default=None):
+        v = getattr(self.conf, name, None)
+        if v is None:
+            v = getattr(self.global_conf, name, None)
+        return default if v is None else v
+
+    @property
+    def param_dtype(self):
+        return jnp.dtype(self.policy.param_dtype)
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.policy.compute_dtype)
+
+    @property
+    def activation_fn(self):
+        return activations_mod.get(self.resolve("activation", "identity"))
+
+    @property
+    def name(self):
+        return self.conf.name
+
+    # ---- params/state -----------------------------------------------------
+    def init_params(self, key) -> dict:
+        return {}
+
+    def init_state(self) -> dict:
+        return {}
+
+    def has_params(self) -> bool:
+        return self.conf.has_params()
+
+    # ---- forward ----------------------------------------------------------
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    def _input_dropout(self, x, train, rng):
+        """Per-layer input dropout (reference: conf.dropOut applied to layer
+        input). ``dropout`` here is the DROP probability; inverted-dropout
+        scaling keeps expectations unchanged at inference."""
+        p = float(self.resolve("dropout", 0.0) or 0.0)
+        if not train or p <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(
+                f"Layer {self.name}: dropout requires an rng during training")
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    # ---- regularization ---------------------------------------------------
+    def regularization(self, params) -> jnp.ndarray:
+        """L1/L2 penalty for this layer's params, matching the reference's
+        score contribution (BaseLayer.calcL2 = 0.5*l2*||W||^2, calcL1 =
+        l1*sum|W|; biases use l1_bias/l2_bias). Included in the loss so
+        autodiff reproduces LayerUpdater.postApply's gradient terms."""
+        if not params:
+            return jnp.zeros((), self.param_dtype)
+        l1 = float(self.resolve("l1", 0.0) or 0.0)
+        l2 = float(self.resolve("l2", 0.0) or 0.0)
+        l1b = float(self.resolve("l1_bias", 0.0) or 0.0)
+        l2b = float(self.resolve("l2_bias", 0.0) or 0.0)
+        total = jnp.zeros((), self.param_dtype)
+        for pname, w in params.items():
+            is_bias = pname in ("b", "bias", "beta")
+            a1, a2 = (l1b, l2b) if is_bias else (l1, l2)
+            if a1:
+                total = total + a1 * jnp.sum(jnp.abs(w))
+            if a2:
+                total = total + 0.5 * a2 * jnp.sum(w * w)
+        return total

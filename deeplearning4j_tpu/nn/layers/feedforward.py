@@ -1,0 +1,127 @@
+"""Dense / output / activation / dropout / embedding runtime layers.
+
+Reference parity: nn/layers/feedforward/dense/DenseLayer.java (preOutput =
+input.mmul(W).addiRowVector(b)), nn/layers/OutputLayer.java (dense + loss;
+loss grad here comes from autodiff, not ILossFunction.computeGradient),
+nn/layers/feedforward/embedding/EmbeddingLayer.java (index lookup).
+
+TPU notes: the matmul is the MXU op; compute dtype may be bf16 while params
+stay f32 (DtypePolicy). Activations fuse into the matmul under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.ops import initializers as init_mod
+from deeplearning4j_tpu.ops import losses as losses_mod
+
+
+class DenseLayer(Layer):
+    def _fans(self):
+        return self.conf.n_in, self.conf.n_out
+
+    def init_params(self, key):
+        fan_in, fan_out = self._fans()
+        wi = self.resolve("weight_init", "xavier")
+        if isinstance(wi, dict):
+            w_fn = init_mod.distribution(wi)
+        else:
+            w_fn = init_mod.get(wi)
+        k_w, _ = jax.random.split(key)
+        W = w_fn(k_w, (fan_in, fan_out), fan_in, fan_out, self.param_dtype)
+        params = {"W": W}
+        if getattr(self.conf, "has_bias", True):
+            params["b"] = jnp.full(
+                (fan_out,), float(self.resolve("bias_init", 0.0)),
+                self.param_dtype)
+        return params
+
+    def preout(self, params, x):
+        cd = self.compute_dtype
+        z = jnp.matmul(x.astype(cd), params["W"].astype(cd))
+        if "b" in params:
+            z = z + params["b"].astype(cd)
+        return z.astype(self.param_dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # 2d [batch, n_in]; time series are flattened by an rnn_to_ff
+        # preprocessor before dense layers (reference layout semantics).
+        x = self._input_dropout(x, train, rng)
+        z = self.preout(params, x)
+        return self.activation_fn(z), state
+
+
+class OutputLayer(DenseLayer):
+    """Dense layer + loss head (OutputLayer.java parity)."""
+
+    @property
+    def loss_fn(self) -> losses_mod.Loss:
+        return losses_mod.get(self.conf.loss)
+
+    def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        z = self.preout(params, x)
+        return self.loss_fn.score(labels, z, self.activation_fn, mask)
+
+
+class LossOnlyLayer(Layer):
+    """Parameter-free loss head (LossLayer.java parity)."""
+
+    @property
+    def loss_fn(self) -> losses_mod.Loss:
+        return losses_mod.get(self.conf.loss)
+
+    def preout(self, params, x):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation_fn(x), state
+
+    def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
+        return self.loss_fn.score(labels, x, self.activation_fn, mask)
+
+
+class ActivationOnlyLayer(Layer):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation_fn(x), state
+
+
+class DropoutOnlyLayer(Layer):
+    """Standalone dropout (DropoutLayer.java parity). Uses the layer's
+    ``dropout`` field (or the global default) as the drop probability."""
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self._input_dropout(x, train, rng), state
+
+
+class EmbeddingLayerImpl(Layer):
+    """Integer-index embedding (EmbeddingLayer.java parity). The reference
+    computes a one-hot mmul; on TPU a gather (jnp.take) is the idiomatic
+    lowering and XLA emits a fused dynamic-gather."""
+
+    def init_params(self, key):
+        n_in, n_out = self.conf.n_in, self.conf.n_out
+        wi = self.resolve("weight_init", "xavier")
+        w_fn = init_mod.distribution(wi) if isinstance(wi, dict) else init_mod.get(wi)
+        W = w_fn(key, (n_in, n_out), n_in, n_out, self.param_dtype)
+        params = {"W": W}
+        if getattr(self.conf, "has_bias", True):
+            params["b"] = jnp.full(
+                (n_out,), float(self.resolve("bias_init", 0.0)), self.param_dtype)
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # x: integer indices [batch] or [batch, 1] (reference accepts a
+        # column of indices) or one-hot [batch, n_in].
+        if x.ndim == 2 and x.shape[-1] == self.conf.n_in and not jnp.issubdtype(
+                x.dtype, jnp.integer):
+            idx = jnp.argmax(x, axis=-1)
+        else:
+            idx = x.reshape(x.shape[0]).astype(jnp.int32)
+        emb = jnp.take(params["W"], idx, axis=0)
+        if "b" in params:
+            emb = emb + params["b"]
+        return self.activation_fn(emb), state

@@ -1,0 +1,382 @@
+"""MultiLayerNetwork — a sequential stack with fit()/output()/evaluate().
+
+Parity: nn/multilayer/MultiLayerNetwork.java (2,590 LoC): init() :903,
+fit(DataSetIterator) :947, output :1512, feedForward :675, evaluate :2413.
+
+TPU-native design (SURVEY.md §7): instead of the reference's per-op JNI
+dispatch through Solver -> StochasticGradientDescent -> per-layer
+backpropGradient (call stack §3.1), ``fit`` compiles ONE jitted train step:
+forward + loss + autodiff backward + gradient normalization + updater +
+parameter update fused into a single XLA program. Parameters/optimizer state
+are pytrees keyed by layer name. Optional distribution: pass a
+``jax.sharding.Mesh`` and the same step is sharded over the 'data' axis
+(gradients all-reduced by XLA over ICI) — see parallel/.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as layer_confs
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForward,
+    FeedForwardToCnn,
+    RnnToFeedForward,
+)
+from deeplearning4j_tpu.nn.updater import normalize_gradients
+
+
+def _auto_preprocessor(input_type: InputType, conf):
+    """Automatic shape-adapter insertion between mismatched layer families
+    (parity: MultiLayerConfiguration setInputType preprocessor inference)."""
+    kind = input_type.kind
+    is_ff = isinstance(conf, layer_confs.FeedForwardLayerConfig)
+    wants_cnn = getattr(conf, "expects_cnn_input", False)
+    wants_rnn = getattr(conf, "expects_rnn_input", False)
+    if kind == "convolutional" and is_ff and not wants_cnn and not wants_rnn:
+        return CnnToFeedForward(input_type.height, input_type.width,
+                                input_type.channels)
+    if kind == "convolutional_flat" and wants_cnn:
+        return FeedForwardToCnn(input_type.height, input_type.width,
+                                input_type.channels)
+    if kind == "recurrent" and is_ff and not wants_rnn and not wants_cnn:
+        return RnnToFeedForward()
+    return None
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = None          # runtime Layer objects
+        self.preprocessors = None   # per-layer-index preprocessor or None
+        self.params = None          # pytree {layer_name: {param: array}}
+        self.state = None           # pytree {layer_name: {...}} (e.g. BN stats)
+        self.opt_state = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value = None
+        self._train_step = None
+        self._apply_fns = {}
+        self._mesh = None
+        self._rng_key = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None):
+        gc = self.conf.global_conf
+        seed = gc.seed if seed is None else seed
+        self._rng_key = jax.random.PRNGKey(seed)
+
+        input_type = self.conf.input_type
+        self.layers = []
+        self.preprocessors = []
+        resolved_confs = []
+        for i, lc in enumerate(self.conf.layers):
+            prep = self.conf.preprocessors.get(i)
+            if prep is None and input_type is not None:
+                prep = _auto_preprocessor(input_type, lc)
+            if prep is not None and input_type is not None:
+                input_type = prep.output_type(input_type)
+            self.preprocessors.append(prep)
+            if input_type is not None:
+                lc = lc.with_n_in(input_type)
+            if getattr(lc, "n_in", 1) is None:
+                raise ValueError(
+                    f"Layer {i} ({lc.layer_type}): n_in not set and no "
+                    f"input_type provided for inference")
+            if lc.name is None:
+                lc = lc.replace(name=f"layer_{i}")
+            resolved_confs.append(lc)
+            layer = lc.make_layer(input_type, gc, gc.dtype)
+            self.layers.append(layer)
+            input_type = layer.output_type
+        self._resolved_confs = resolved_confs
+
+        # init params + state
+        key = self._rng_key
+        params, state = {}, {}
+        for layer in self.layers:
+            key, sub = jax.random.split(key)
+            p = layer.init_params(sub)
+            if p:
+                params[layer.name] = p
+            s = layer.init_state()
+            if s:
+                state[layer.name] = s
+        self.params = params
+        self.state = state
+
+        # per-layer optimizer state
+        opt_state = {}
+        for layer in self.layers:
+            if layer.name in params:
+                upd = layer.resolve("updater")
+                opt_state[layer.name] = upd.init_state(params[layer.name])
+        self.opt_state = opt_state
+        self.iteration = 0
+        self._train_step = None
+        self._apply_fns = {}
+        return self
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    def use_mesh(self, mesh, data_axis: str = "data"):
+        """Shard training over a jax Mesh: batches split on ``data_axis``,
+        params replicated; XLA inserts the gradient all-reduce over ICI.
+        (Replaces ParallelWrapper/Spark parameter averaging — SURVEY.md §2.8.)"""
+        from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
+        self._mesh = (mesh, data_axis)
+        self._train_step = None
+        self._apply_fns = {}
+        apply_mesh(self, mesh, data_axis)
+        return self
+
+    # -------------------------------------------------------------- forward
+    def _forward(self, params, state, x, *, train, rng, fmask=None,
+                 to_layer: Optional[int] = None, collect=False):
+        """Walk the stack; returns (final activation or list, new_state)."""
+        acts = []
+        new_state = dict(state)
+        n = len(self.layers) if to_layer is None else to_layer
+        for i in range(n):
+            layer = self.layers[i]
+            if self.preprocessors[i] is not None:
+                x = self.preprocessors[i](x)
+            lrng = None
+            if rng is not None:
+                rng, lrng = jax.random.split(rng)
+            p = params.get(layer.name, {})
+            s = state.get(layer.name, {})
+            x, s_new = layer.apply(p, s, x, train=train, rng=lrng, mask=fmask)
+            if s_new:
+                new_state[layer.name] = s_new
+            if collect:
+                acts.append(x)
+        return (acts if collect else x), new_state
+
+    def _loss(self, params, state, x, labels, fmask, lmask, rng, train=True):
+        """Data loss + regularization: the scalar the jitted step autodiffs."""
+        rng_fwd = lrng = None
+        if rng is not None:
+            rng_fwd, lrng = jax.random.split(rng)
+        h, new_state = self._forward(params, state, x, train=train, rng=rng_fwd,
+                                     fmask=fmask, to_layer=len(self.layers) - 1)
+        out_layer = self.layers[-1]
+        if self.preprocessors[-1] is not None:
+            h = self.preprocessors[-1](h)
+        p_out = params.get(out_layer.name, {})
+        data_loss = out_layer.loss(p_out, h, labels, train=train, rng=lrng,
+                                   mask=lmask)
+        reg = jnp.zeros((), data_loss.dtype)
+        for layer in self.layers:
+            if layer.name in params:
+                reg = reg + layer.regularization(params[layer.name])
+        return data_loss + reg, new_state
+
+    # ---------------------------------------------------------- train step
+    def _build_train_step(self):
+        gc = self.conf.global_conf
+        layers = self.layers
+
+        def loss_fn(params, state, x, labels, fmask, lmask, rng):
+            return self._loss(params, state, x, labels, fmask, lmask, rng)
+
+        def step_fn(params, state, opt_state, it, x, labels, fmask, lmask, rng):
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, x, labels, fmask, lmask,
+                                       rng)
+            new_params = dict(params)
+            new_opt = dict(opt_state)
+            for layer in layers:
+                name = layer.name
+                if name not in params:
+                    continue
+                g = grads[name]
+                # preApply: gradient clipping / normalization
+                mode = layer.resolve("gradient_normalization")
+                thr = float(layer.resolve("gradient_normalization_threshold",
+                                          1.0) or 1.0)
+                g = normalize_gradients(g, mode, thr)
+                upd = layer.resolve("updater")
+                base_lr = layer.conf.learning_rate
+                if base_lr is None:
+                    base_lr = gc.learning_rate
+                if base_lr is None:
+                    base_lr = upd.learning_rate
+                lr = gc.lr_schedule(base_lr, it)
+                deltas, new_opt[name] = upd.update(g, opt_state[name], lr)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, d: p - d, params[name], deltas)
+            return new_params, new_state, new_opt, score
+
+        jit_kwargs = {"donate_argnums": (0, 1, 2)}
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.data_parallel import shard_step
+            return shard_step(self, step_fn, *self._mesh)
+        return jax.jit(step_fn, **jit_kwargs)
+
+    def _require_init(self):
+        if self.params is None:
+            raise RuntimeError(
+                "Network not initialized — call net.init() before "
+                "fit()/output()/evaluate()")
+
+    def fit_batch(self, ds: DataSet):
+        """One optimization step on one minibatch (Model.fit parity)."""
+        self._require_init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        self._rng_key, rng = jax.random.split(self._rng_key)
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        it = jnp.asarray(self.iteration, jnp.int32)
+        self.params, self.state, self.opt_state, score = self._train_step(
+            self.params, self.state, self.opt_state, it, x, y, fmask, lmask, rng)
+        self.iteration += 1
+        self.score_value = score
+        self.last_batch_examples = ds.num_examples
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+        return score
+
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            async_prefetch: bool = True):
+        """Train. Accepts a DataSetIterator, a DataSet, or (features, labels)
+        arrays (MultiLayerNetwork.fit overloads parity; iterator is wrapped
+        in an async prefetcher like MultiLayerNetwork.java:951)."""
+        if isinstance(data, DataSetIterator):
+            it = data
+        elif isinstance(data, DataSet):
+            it = ListDataSetIterator([data])
+        else:
+            it = ArrayDataSetIterator(data, labels, batch_size=batch_size)
+        for epoch in range(epochs):
+            source = AsyncDataSetIterator(it) if async_prefetch else it
+            for l in self.listeners:
+                l.on_epoch_start(self)
+            for ds in source:
+                self.fit_batch(ds)
+            for l in self.listeners:
+                l.on_epoch_end(self)
+            self.epoch += 1
+            it.reset()
+        return self
+
+    # ------------------------------------------------------------ inference
+    def _get_apply(self, collect=False, train=False):
+        key = (collect, train)
+        if key not in self._apply_fns:
+            def apply_fn(params, state, x, rng):
+                out, _ = self._forward(params, state, x, train=train, rng=rng,
+                                       collect=collect)
+                return out
+            self._apply_fns[key] = jax.jit(apply_fn)
+        return self._apply_fns[key]
+
+    def _inference_rng(self, train):
+        if not train:
+            return None
+        self._rng_key, rng = jax.random.split(self._rng_key)
+        return rng
+
+    def output(self, x, train: bool = False):
+        """Forward pass -> final layer activations
+        (MultiLayerNetwork.output :1512)."""
+        self._require_init()
+        fn = self._get_apply(collect=False, train=train)
+        return fn(self.params, self.state, jnp.asarray(x),
+                  self._inference_rng(train))
+
+    def feed_forward(self, x, train: bool = False) -> List[jnp.ndarray]:
+        """All layer activations (feedForward :675)."""
+        self._require_init()
+        fn = self._get_apply(collect=True, train=train)
+        return fn(self.params, self.state, jnp.asarray(x),
+                  self._inference_rng(train))
+
+    def score(self, ds: DataSet, train: bool = False):
+        """Loss on one dataset (MultiLayerNetwork.score parity)."""
+        self._require_init()
+        loss, _ = self._loss(
+            self.params, self.state, jnp.asarray(ds.features),
+            jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            rng=None, train=train)
+        return float(loss)
+
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (evaluate :2413)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        if isinstance(iterator, DataSet):
+            iterator = ListDataSetIterator([iterator])
+        for ds in iterator:
+            out = np.asarray(self.output(ds.features))
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        ev = RegressionEvaluation()
+        if isinstance(iterator, DataSet):
+            iterator = ListDataSetIterator([iterator])
+        for ds in iterator:
+            out = np.asarray(self.output(ds.features))
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    # ---------------------------------------------------------------- misc
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    def summary(self) -> str:
+        lines = ["=" * 70]
+        lines.append(f"{'name':<18}{'type':<16}{'out type':<22}{'params':>10}")
+        lines.append("-" * 70)
+        for layer in self.layers:
+            p = self.params.get(layer.name, {})
+            n = sum(int(np.prod(a.shape)) for a in jax.tree_util.tree_leaves(p))
+            lines.append(
+                f"{layer.name:<18}{layer.conf.layer_type:<16}"
+                f"{str(layer.output_type.kind):<22}{n:>10}")
+        lines.append("-" * 70)
+        lines.append(f"total params: {self.num_params()}")
+        lines.append("=" * 70)
+        return "\n".join(lines)
+
+    def clone(self):
+        """Deep copy (Model.clone parity) — used by transfer learning.
+        Leaves are materially copied (jnp.copy): the jitted train step
+        donates its input buffers, so an aliasing clone would be invalidated
+        by the next fit_batch on either net."""
+        net = MultiLayerNetwork(self.conf)
+        net.init()
+        net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+        net.state = jax.tree_util.tree_map(jnp.copy, self.state)
+        net.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
+        net.iteration = self.iteration
+        net.epoch = self.epoch
+        return net

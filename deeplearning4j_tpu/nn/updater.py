@@ -1,0 +1,399 @@
+"""Updaters (optimizer state) + learning-rate schedules + gradient
+normalization.
+
+Parity with the reference's updater subsystem
+(nn/updater/LayerUpdater.java: per-variable GradientUpdater construction at
+:259-278 for SGD/ADAM/ADADELTA/NESTEROVS/ADAGRAD/RMSPROP; gradient
+clipping/normalization `preApply` at :186 per GradientNormalization;
+learning-rate schedules via LearningRatePolicy).
+
+TPU-native design: an updater is a pure pytree transform —
+``init_state(params) -> state`` and
+``update(grads, state, lr) -> (deltas, new_state)`` with
+``new_params = params - deltas``. The whole update runs inside the single
+jitted train step; per-layer updaters simply apply to that layer's subtree.
+Unlike the reference there is no flat state vector with views
+(MultiLayerUpdater.java:161) — state is a pytree mirroring params, which XLA
+lays out and fuses freely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+_UPDATERS: dict[str, type] = {}
+
+
+def register_updater(cls):
+    _UPDATERS[cls.kind] = cls
+    return cls
+
+
+def updater_from_dict(d: dict) -> "Updater":
+    d = dict(d)
+    kind = d.pop("kind")
+    return _UPDATERS[kind](**d)
+
+
+@dataclass(frozen=True)
+class Updater:
+    """Base optimizer config. Stateless; per-variable state is a pytree."""
+
+    kind = "base"
+    learning_rate: float = 0.1
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["kind"] = self.kind
+        return d
+
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, state, lr):
+        raise NotImplementedError
+
+    def _zeros_like(self, params):
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+@register_updater
+@dataclass(frozen=True)
+class Sgd(Updater):
+    kind = "sgd"
+
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, state, lr):
+        return jax.tree_util.tree_map(lambda g: lr * g, grads), state
+
+
+@register_updater
+@dataclass(frozen=True)
+class Nesterovs(Updater):
+    """Nesterov momentum, matching ND4J's NesterovsUpdater formulation:
+    vPrev = v; v = mu*v - lr*g; update = -(mu*vPrev - (1+mu)*v)
+    (equivalently: update applied = mu^2*vPrev - (1+mu)*mu*... — we keep the
+    ND4J two-line form)."""
+
+    kind = "nesterovs"
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init_state(self, params):
+        return {"v": self._zeros_like(params)}
+
+    def update(self, grads, state, lr):
+        mu = self.momentum
+
+        def upd(g, v):
+            v_new = mu * v - lr * g
+            delta = mu * v - (1.0 + mu) * v_new  # subtracted from params
+            return delta, v_new
+
+        pairs = jax.tree_util.tree_map(upd, grads, state["v"])
+        deltas = jax.tree_util.tree_map(lambda p: p[0], pairs,
+                                        is_leaf=lambda x: isinstance(x, tuple))
+        v = jax.tree_util.tree_map(lambda p: p[1], pairs,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+        return deltas, {"v": v}
+
+
+@register_updater
+@dataclass(frozen=True)
+class Adam(Updater):
+    kind = "adam"
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {
+            "m": self._zeros_like(params),
+            "v": self._zeros_like(params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, state, lr):
+        t = state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(
+            lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(
+            lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        tf = t.astype(jnp.float32)
+        alpha = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        deltas = jax.tree_util.tree_map(
+            lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + self.epsilon), m, v)
+        return deltas, {"m": m, "v": v, "t": t}
+
+
+@register_updater
+@dataclass(frozen=True)
+class AdaMax(Updater):
+    kind = "adamax"
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {
+            "m": self._zeros_like(params),
+            "u": self._zeros_like(params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, state, lr):
+        t = state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(
+            lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = jax.tree_util.tree_map(
+            lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g)), state["u"], grads)
+        tf = t.astype(jnp.float32)
+        alpha = lr / (1 - b1 ** tf)
+        deltas = jax.tree_util.tree_map(
+            lambda m_, u_: alpha * m_ / (u_ + self.epsilon), m, u)
+        return deltas, {"m": m, "u": u, "t": t}
+
+
+@register_updater
+@dataclass(frozen=True)
+class AdaGrad(Updater):
+    kind = "adagrad"
+    learning_rate: float = 1e-1
+    epsilon: float = 1e-6
+
+    def init_state(self, params):
+        return {"h": self._zeros_like(params)}
+
+    def update(self, grads, state, lr):
+        h = jax.tree_util.tree_map(lambda h_, g: h_ + g * g, state["h"], grads)
+        deltas = jax.tree_util.tree_map(
+            lambda g, h_: lr * g / (jnp.sqrt(h_) + self.epsilon), grads, h)
+        return deltas, {"h": h}
+
+
+@register_updater
+@dataclass(frozen=True)
+class AdaDelta(Updater):
+    kind = "adadelta"
+    rho: float = 0.95
+    epsilon: float = 1e-6
+    learning_rate: float = 1.0  # unused by the rule; kept for API uniformity
+
+    def init_state(self, params):
+        return {"eg": self._zeros_like(params), "ex": self._zeros_like(params)}
+
+    def update(self, grads, state, lr):
+        rho, eps = self.rho, self.epsilon
+
+        def upd(g, eg, ex):
+            eg_new = rho * eg + (1 - rho) * g * g
+            delta = jnp.sqrt(ex + eps) / jnp.sqrt(eg_new + eps) * g
+            ex_new = rho * ex + (1 - rho) * delta * delta
+            return delta, eg_new, ex_new
+
+        triples = jax.tree_util.tree_map(upd, grads, state["eg"], state["ex"])
+        is_t = lambda x: isinstance(x, tuple)
+        deltas = jax.tree_util.tree_map(lambda p: p[0], triples, is_leaf=is_t)
+        eg = jax.tree_util.tree_map(lambda p: p[1], triples, is_leaf=is_t)
+        ex = jax.tree_util.tree_map(lambda p: p[2], triples, is_leaf=is_t)
+        return deltas, {"eg": eg, "ex": ex}
+
+
+@register_updater
+@dataclass(frozen=True)
+class RmsProp(Updater):
+    kind = "rmsprop"
+    learning_rate: float = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"g2": self._zeros_like(params)}
+
+    def update(self, grads, state, lr):
+        d = self.rms_decay
+        g2 = jax.tree_util.tree_map(
+            lambda a, g: d * a + (1 - d) * g * g, state["g2"], grads)
+        deltas = jax.tree_util.tree_map(
+            lambda g, a: lr * g / (jnp.sqrt(a + self.epsilon)), grads, g2)
+        return deltas, {"g2": g2}
+
+
+@register_updater
+@dataclass(frozen=True)
+class NoOp(Updater):
+    """For frozen layers (FrozenLayer.java parity): gradient is discarded."""
+
+    kind = "noop"
+    learning_rate: float = 0.0
+
+    def update(self, grads, state, lr):
+        return jax.tree_util.tree_map(jnp.zeros_like, grads), state
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules (LearningRatePolicy parity)
+# ---------------------------------------------------------------------------
+
+_SCHEDULES: dict[str, type] = {}
+
+
+def register_schedule(cls):
+    _SCHEDULES[cls.kind] = cls
+    return cls
+
+
+def schedule_from_dict(d):
+    if d is None:
+        return NoneSchedule()
+    d = dict(d)
+    kind = d.pop("kind")
+    # JSON turns int dict keys into strings; restore for map schedules.
+    if "schedule" in d and isinstance(d["schedule"], dict):
+        d["schedule"] = {int(k): float(v) for k, v in d["schedule"].items()}
+    return _SCHEDULES[kind](**d)
+
+
+@dataclass(frozen=True)
+class Schedule:
+    kind = "base"
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["kind"] = self.kind
+        return d
+
+    def __call__(self, base_lr, step):
+        raise NotImplementedError
+
+
+@register_schedule
+@dataclass(frozen=True)
+class NoneSchedule(Schedule):
+    kind = "none"
+
+    def __call__(self, base_lr, step):
+        return jnp.asarray(base_lr, jnp.float32)
+
+
+@register_schedule
+@dataclass(frozen=True)
+class Exponential(Schedule):
+    kind = "exponential"
+    decay_rate: float = 0.99
+
+    def __call__(self, base_lr, step):
+        return base_lr * self.decay_rate ** step.astype(jnp.float32)
+
+
+@register_schedule
+@dataclass(frozen=True)
+class Inverse(Schedule):
+    kind = "inverse"
+    gamma: float = 1e-3
+    power: float = 1.0
+
+    def __call__(self, base_lr, step):
+        return base_lr / (1.0 + self.gamma * step.astype(jnp.float32)) ** self.power
+
+
+@register_schedule
+@dataclass(frozen=True)
+class Poly(Schedule):
+    kind = "poly"
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def __call__(self, base_lr, step):
+        frac = jnp.clip(step.astype(jnp.float32) / self.max_iter, 0.0, 1.0)
+        return base_lr * (1.0 - frac) ** self.power
+
+
+@register_schedule
+@dataclass(frozen=True)
+class Sigmoid(Schedule):
+    kind = "sigmoid"
+    gamma: float = 1e-2
+    steps: int = 1000
+
+    def __call__(self, base_lr, step):
+        return base_lr / (
+            1.0 + jnp.exp(self.gamma * (step.astype(jnp.float32) - self.steps)))
+
+
+@register_schedule
+@dataclass(frozen=True)
+class Step(Schedule):
+    kind = "step"
+    decay_rate: float = 0.1
+    steps: int = 1000
+
+    def __call__(self, base_lr, step):
+        return base_lr * self.decay_rate ** jnp.floor(
+            step.astype(jnp.float32) / self.steps)
+
+
+@register_schedule
+@dataclass(frozen=True)
+class MapSchedule(Schedule):
+    """LearningRatePolicy.Schedule: explicit {iteration: lr} map; the lr at
+    step t is the value for the largest key <= t (base_lr before the first)."""
+
+    kind = "map"
+    schedule: dict = field(default_factory=dict)
+
+    def __call__(self, base_lr, step):
+        lr = jnp.asarray(base_lr, jnp.float32)
+        for it in sorted(self.schedule):
+            lr = jnp.where(step >= it, jnp.float32(self.schedule[it]), lr)
+        return lr
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization (GradientNormalization.java parity; LayerUpdater
+# preApply at nn/updater/LayerUpdater.java:186)
+# ---------------------------------------------------------------------------
+
+def normalize_gradients(grads, mode: str | None, threshold: float = 1.0):
+    """Apply a GradientNormalization mode to one layer's gradient subtree.
+
+    Modes (matching the reference enum): None, "renormalize_l2_per_layer",
+    "renormalize_l2_per_param_type", "clip_element_wise_absolute_value",
+    "clip_l2_per_layer", "clip_l2_per_param_type".
+    """
+    if mode in (None, "none"):
+        return grads
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return grads
+    if mode == "renormalize_l2_per_layer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = 1.0 / jnp.maximum(norm, 1e-12)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if mode == "renormalize_l2_per_param_type":
+        return jax.tree_util.tree_map(
+            lambda g: g / jnp.maximum(jnp.linalg.norm(g.reshape(-1)), 1e-12), grads)
+    if mode == "clip_element_wise_absolute_value":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == "clip_l2_per_layer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if mode == "clip_l2_per_param_type":
+        def clip_one(g):
+            n = jnp.linalg.norm(g.reshape(-1))
+            s = jnp.where(n > threshold, threshold / (n + 1e-12), 1.0)
+            return g * s
+        return jax.tree_util.tree_map(clip_one, grads)
+    raise ValueError(f"Unknown gradient normalization mode: {mode}")

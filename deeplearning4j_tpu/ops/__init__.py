@@ -1,0 +1,3 @@
+"""Low-level op layer: activations, losses, initializers, and the
+op-lowering registry (the TPU analogue of the reference's cuDNN Helper seam,
+see SURVEY.md §2.0 / deeplearning4j-cuda CudnnConvolutionHelper.java:49)."""

@@ -1,0 +1,155 @@
+"""Activation functions.
+
+Capability parity with the reference's ND4J ``IActivation`` set (consumed by
+deeplearning4j-nn layers, see SURVEY.md §1 L0: `IActivation` imported 18x in
+deeplearning4j-nn). Implemented as pure jnp functions so XLA fuses them into
+the surrounding matmul/conv; no manual backprop is needed (JAX autodiff).
+
+Each activation is registered by its canonical lower-case name; configs store
+the string name so JSON round-trips are trivial.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY: dict[str, callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        fn.activation_name = name
+        return fn
+
+    return deco
+
+
+def get(name):
+    """Resolve an activation by name (case-insensitive). Callables pass through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+@register("identity")
+def identity(x):
+    return x
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("leakyrelu")
+def leakyrelu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("softmax")
+def softmax(x):
+    # Row-wise softmax over the feature axis (last axis), matching the
+    # reference's OldSoftMax-on-2d semantics.
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("logsoftmax")
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@register("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@register("gelu")
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+@register("swish")
+def swish(x):
+    return jax.nn.swish(x)
+
+
+@register("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register("hardsigmoid")
+def hardsigmoid(x):
+    # DL4J/Keras-1 definition: clip(0.2*x + 0.5, 0, 1)
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@register("cube")
+def cube(x):
+    return x * x * x
+
+
+@register("rationaltanh")
+def rationaltanh(x):
+    # tanh approximation used by ND4J: 1.7159 * tanh(2x/3), via the rational
+    # approximation f(x) = 1.7159 * sgn(x) * (1 - 1/(1+|a|+a^2+1.41645 a^4)),
+    # a = 2x/3. We keep the exact closed form (autodiff handles the rest).
+    a = 2.0 * x / 3.0
+    abs_a = jnp.abs(a)
+    f = 1.0 - 1.0 / (1.0 + abs_a + a * a + 1.41645 * (a ** 4))
+    return 1.7159 * jnp.sign(x) * f
+
+
+@register("rectifiedtanh")
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register("rrelu")
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0):
+    # Randomized ReLU: at inference the reference uses the midpoint slope.
+    # The train-time randomized slope requires an rng; layers that care pass
+    # one explicitly. Default = deterministic midpoint (eval semantics).
+    mid = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, mid * x)
+
+
+@register("thresholdedrelu")
+def thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)

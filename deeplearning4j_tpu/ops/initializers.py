@@ -1,0 +1,133 @@
+"""Weight initialization.
+
+Parity with the reference's ``WeightInit`` enum
+(deeplearning4j-nn/.../nn/weights/WeightInit.java:47: DISTRIBUTION, ZERO,
+SIGMOID_UNIFORM, UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN,
+XAVIER_LEGACY, RELU, RELU_UNIFORM) and WeightInitUtil.java's formulas.
+
+TPU-native design: initializers are pure functions of (key, shape, fan_in,
+fan_out, dtype) — per-layer params live in a pytree, not views into one flat
+vector (XLA fusion makes the reference's contiguous-buffer trick obsolete,
+see SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY = {}
+
+
+def register(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get(name):
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown weight init '{name}'. Available: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+@register("zero")
+def zero(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+@register("ones")
+def ones(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+@register("uniform")
+def uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # WeightInitUtil: U(-a, a), a = 1/sqrt(fanIn)
+    a = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("xavier")
+def xavier(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # Gaussian, var = 2/(fanIn + fanOut)
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("xavier_uniform")
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("xavier_fan_in")
+def xavier_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(1.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("xavier_legacy")
+def xavier_legacy(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(1.0 / (fan_in + fan_out))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("relu")
+def relu(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # He init: N(0, 2/fanIn)
+    std = math.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("relu_uniform")
+def relu_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(6.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("sigmoid_uniform")
+def sigmoid_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("normal")
+def normal(key, shape, fan_in, fan_out, dtype=jnp.float32, std=1.0):
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def distribution(dist: dict):
+    """WeightInit.DISTRIBUTION: a user-supplied distribution spec, e.g.
+    {"type": "normal", "mean": 0, "std": 0.01} or
+    {"type": "uniform", "lower": -a, "upper": a}."""
+
+    def init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+        t = dist.get("type", "normal")
+        if t == "normal" or t == "gaussian":
+            return dist.get("mean", 0.0) + dist.get("std", 1.0) * jax.random.normal(
+                key, shape, dtype
+            )
+        if t == "uniform":
+            return jax.random.uniform(
+                key, shape, dtype,
+                minval=dist.get("lower", -1.0), maxval=dist.get("upper", 1.0),
+            )
+        if t == "binomial":
+            p = dist.get("probability", 0.5)
+            n = dist.get("trials", 1)
+            return jax.random.binomial(key, n, p, shape).astype(dtype)
+        raise ValueError(f"Unknown distribution type {t}")
+
+    return init

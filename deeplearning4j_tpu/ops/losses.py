@@ -1,0 +1,253 @@
+"""Loss functions.
+
+Capability parity with ND4J's ``ILossFunction`` set as consumed by the
+reference's output layers (SURVEY.md §1 L0: `ILossFunction` imported 15x in
+deeplearning4j-nn; score + initial epsilon computed at
+nn/layers/OutputLayer via ILossFunction).
+
+Design (TPU-native): a loss is a pure function
+``loss(labels, preout, activation_fn, mask=None, weights=None) -> per-example
+losses`` — the *gradient* w.r.t. pre-output comes from JAX autodiff of the
+whole network, so no `computeGradient` twin is needed. All losses support
+per-timestep/per-example masks (broadcast against the example axis) and
+optional per-output weights, matching the reference's masking semantics
+(util/MaskedReductionUtil.java, GradientCheckTestsMasking).
+
+Score convention: `score(...)` returns the mean over (unmasked) examples of
+the per-example loss summed over output dims — matching DL4J's
+"sum over outputs, average over minibatch" convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import activations as _act
+
+_REGISTRY: dict[str, "Loss"] = {}
+
+_EPS = 1e-7
+
+
+class Loss:
+    """A named loss. ``per_example(labels, output)`` returns shape
+    ``labels.shape`` elementwise losses (before output-dim reduction)."""
+
+    name: str = "base"
+
+    def elementwise(self, labels, output):
+        raise NotImplementedError
+
+    # Some losses (MCXENT+softmax) want the preoutput for numerical stability;
+    # default path applies the activation then the elementwise loss.
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        out = activation_fn(preout)
+        l = self.elementwise(labels, out)
+        if weights is not None:
+            l = l * weights
+        # Sum over output dims -> per-example scalar. Works for 2d
+        # [batch, out] and, for time series, callers reshape to 2d first.
+        return jnp.sum(l, axis=-1)
+
+    def __call__(self, labels, preout, activation_fn, mask=None, weights=None):
+        return self.score(labels, preout, activation_fn, mask, weights)
+
+    def score(self, labels, preout, activation_fn, mask=None, weights=None):
+        per_ex = self.per_example(labels, preout, activation_fn, weights)
+        if mask is not None:
+            mask = jnp.reshape(mask, per_ex.shape)
+            per_ex = per_ex * mask
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            denom = per_ex.size
+        return jnp.sum(per_ex) / denom
+
+
+def register(cls):
+    inst = cls()
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def get(name):
+    if isinstance(name, Loss):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+@register
+class MCXENT(Loss):
+    """Multi-class cross entropy: -sum(y * log(p)). With a softmax activation
+    the preoutput path uses log_softmax for stability (the fused
+    softmax+xent the reference gets from native libnd4j ops)."""
+
+    name = "mcxent"
+
+    def elementwise(self, labels, output):
+        return -labels * jnp.log(jnp.clip(output, _EPS, 1.0 - _EPS))
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        if getattr(activation_fn, "activation_name", None) == "softmax":
+            logp = jax.nn.log_softmax(preout, axis=-1)
+            l = -labels * logp
+        else:
+            l = self.elementwise(labels, activation_fn(preout))
+        if weights is not None:
+            l = l * weights
+        return jnp.sum(l, axis=-1)
+
+
+@register
+class NegativeLogLikelihood(MCXENT):
+    name = "negativeloglikelihood"
+
+
+@register
+class MSE(Loss):
+    """Mean squared error (per DL4J: squared error summed over outputs /
+    averaged over examples... reference divides by nOut as well: LossMSE =
+    LossL2 / nOut)."""
+
+    name = "mse"
+
+    def elementwise(self, labels, output):
+        d = output - labels
+        return d * d
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        l = super().per_example(labels, preout, activation_fn, weights)
+        return l / labels.shape[-1]
+
+
+@register
+class L2(Loss):
+    name = "l2"
+
+    def elementwise(self, labels, output):
+        d = output - labels
+        return d * d
+
+
+@register
+class L1(Loss):
+    name = "l1"
+
+    def elementwise(self, labels, output):
+        return jnp.abs(output - labels)
+
+
+@register
+class MAE(Loss):
+    name = "mae"
+
+    def elementwise(self, labels, output):
+        return jnp.abs(output - labels)
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        l = super().per_example(labels, preout, activation_fn, weights)
+        return l / labels.shape[-1]
+
+
+@register
+class XENT(Loss):
+    """Binary cross entropy (per-output independent sigmoid)."""
+
+    name = "xent"
+
+    def elementwise(self, labels, output):
+        p = jnp.clip(output, _EPS, 1.0 - _EPS)
+        return -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        if getattr(activation_fn, "activation_name", None) == "sigmoid":
+            # stable form: max(x,0) - x*y + log(1+exp(-|x|))
+            x = preout
+            l = jnp.maximum(x, 0.0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        else:
+            l = self.elementwise(labels, activation_fn(preout))
+        if weights is not None:
+            l = l * weights
+        return jnp.sum(l, axis=-1)
+
+
+@register
+class Hinge(Loss):
+    name = "hinge"
+
+    def elementwise(self, labels, output):
+        # labels in {-1, +1} (or {0,1} mapped by caller)
+        return jnp.maximum(0.0, 1.0 - labels * output)
+
+
+@register
+class SquaredHinge(Loss):
+    name = "squaredhinge"
+
+    def elementwise(self, labels, output):
+        h = jnp.maximum(0.0, 1.0 - labels * output)
+        return h * h
+
+
+@register
+class KLDivergence(Loss):
+    name = "kldivergence"
+
+    def elementwise(self, labels, output):
+        y = jnp.clip(labels, _EPS, 1.0)
+        p = jnp.clip(output, _EPS, 1.0)
+        return y * (jnp.log(y) - jnp.log(p))
+
+
+@register
+class MAPE(Loss):
+    name = "mape"
+
+    def elementwise(self, labels, output):
+        return 100.0 * jnp.abs((labels - output) / jnp.clip(jnp.abs(labels), _EPS))
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        l = super().per_example(labels, preout, activation_fn, weights)
+        return l / labels.shape[-1]
+
+
+@register
+class MSLE(Loss):
+    name = "msle"
+
+    def elementwise(self, labels, output):
+        d = jnp.log1p(output) - jnp.log1p(labels)
+        return d * d
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        l = super().per_example(labels, preout, activation_fn, weights)
+        return l / labels.shape[-1]
+
+
+@register
+class Poisson(Loss):
+    name = "poisson"
+
+    def elementwise(self, labels, output):
+        p = jnp.clip(output, _EPS, None)
+        return p - labels * jnp.log(p)
+
+
+@register
+class CosineProximity(Loss):
+    name = "cosineproximity"
+
+    def per_example(self, labels, preout, activation_fn, weights=None):
+        out = activation_fn(preout)
+        if weights is not None:
+            out = out * weights
+        num = jnp.sum(labels * out, axis=-1)
+        den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+        return -num / jnp.clip(den, _EPS, None)

@@ -1,0 +1,10 @@
+"""Optimization drivers + listeners (parity: optimize/ in the reference).
+The SGD train step itself lives fused inside MultiLayerNetwork's jitted step;
+this package holds the listener API and the full-batch optimizers."""
+
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+)

@@ -1,0 +1,120 @@
+"""Training listeners.
+
+Parity: optimize/api/IterationListener.java + TrainingListener.java and the
+impls in optimize/listeners/ (ScoreIterationListener, PerformanceListener,
+CollectScoresIterationListener, ComposableIterationListener).
+
+Note: reading ``net.score_value`` forces a device sync; listeners that log
+every iteration therefore sample (print frequency) exactly like the
+reference, and PerformanceListener measures wall-clock between calls without
+forcing a sync unless reporting.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """Base listener (TrainingListener.java parity: onEpochStart/End,
+    iterationDone; forward/backward hooks are meaningless inside one fused
+    XLA step, so they are not exposed)."""
+
+    def iteration_done(self, net, iteration: int, epoch: int):
+        pass
+
+    def on_epoch_start(self, net):
+        pass
+
+    def on_epoch_end(self, net):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Logs the loss every N iterations (ScoreIterationListener parity)."""
+
+    def __init__(self, print_iterations: int = 10, out=None):
+        self.print_iterations = max(1, print_iterations)
+        self.out = out
+
+    def iteration_done(self, net, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            msg = (f"Score at iteration {iteration} is "
+                   f"{float(net.score_value):.6f}")
+            if self.out is not None:
+                print(msg, file=self.out)
+            else:
+                logger.info(msg)
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collects (iteration, score) pairs (CollectScoresIterationListener
+    parity)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, net, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(net.score_value)))
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting (PerformanceListener parity: iterations/sec,
+    examples/sec, iteration wall time)."""
+
+    def __init__(self, frequency: int = 10, report_examples: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_examples = report_examples
+        self._last_time = None
+        self._last_iter = None
+        self._examples = 0
+        self.records: list[dict] = []
+
+    def iteration_done(self, net, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time, self._last_iter = now, iteration
+            self._examples = 0
+            return
+        self._examples += getattr(net, "last_batch_examples", 0)
+        if iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            rec = {
+                "iteration": iteration,
+                "iterations_per_sec": iters / dt if dt > 0 else float("inf"),
+                "ms_per_iteration": 1000.0 * dt / max(iters, 1),
+            }
+            msg = (f"iteration {iteration}: "
+                   f"{rec['iterations_per_sec']:.1f} it/s, "
+                   f"{rec['ms_per_iteration']:.2f} ms/it")
+            if self.report_examples and self._examples:
+                rec["examples_per_sec"] = (
+                    self._examples / dt if dt > 0 else float("inf"))
+                msg += f", {rec['examples_per_sec']:.1f} examples/s"
+            self.records.append(rec)
+            logger.info(msg)
+            self._last_time, self._last_iter = now, iteration
+            self._examples = 0
+
+
+class ComposableIterationListener(TrainingListener):
+    def __init__(self, *listeners):
+        self.listeners = listeners
+
+    def iteration_done(self, net, iteration, epoch):
+        for l in self.listeners:
+            l.iteration_done(net, iteration, epoch)
+
+    def on_epoch_start(self, net):
+        for l in self.listeners:
+            l.on_epoch_start(net)
+
+    def on_epoch_end(self, net):
+        for l in self.listeners:
+            l.on_epoch_end(net)

@@ -1,0 +1,20 @@
+"""Parallel training (parity: deeplearning4j-scaleout — ParallelWrapper,
+Spark ParameterAveragingTrainingMaster, Aeron parameter server; SURVEY.md
+§2.8/§5.8).
+
+TPU-native design: all data movement is expressed as shardings over a
+``jax.sharding.Mesh``; XLA emits the collectives (all-reduce over ICI within
+a slice, DCN across slices). There is no parameter server and no driver in
+the training path — gradient averaging is a ``psum`` fused into the train
+step. The reference's ParameterAveraging *semantics* (average params every k
+local steps) is provided as ``ParameterAveragingTrainer`` for
+single-machine-equivalence tests.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.data_parallel import (
+    apply_mesh,
+    shard_step,
+    shard_batch,
+    ParallelWrapper,
+)

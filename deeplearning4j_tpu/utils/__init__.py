@@ -1,0 +1,1 @@
+"""Utilities: gradient checking, model serialization, misc."""

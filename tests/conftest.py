@@ -1,0 +1,25 @@
+"""Test configuration: run on a virtual 8-device CPU mesh so sharding tests
+exercise real multi-device semantics without TPU hardware (the driver
+dry-runs the multi-chip path the same way), and enable x64 so gradient
+checks can run in float64 like the reference's (double-precision) checks.
+
+Note: the environment may pre-import jax with a TPU platform registered (via
+sitecustomize), so setting JAX_PLATFORMS in os.environ is not enough — we
+switch platforms through jax.config, which takes effect because no backend
+has been initialized yet at conftest time.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,205 @@
+"""End-to-end MultiLayerNetwork tests: the minimum slice of SURVEY.md §7
+stage 3 — config -> init -> fit -> evaluate on a synthetic classification
+task (MNIST-shaped), plus config JSON round-trip (the reference's
+regression-test surface)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+
+def make_blobs(n=512, dim=20, classes=4, seed=0):
+    """Linearly separable gaussian blobs -> (features, one-hot labels)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 4.0, (classes, dim))
+    idx = rng.integers(0, classes, n)
+    x = centers[idx] + rng.normal(0, 1.0, (n, dim))
+    y = np.eye(classes)[idx]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def build_mlp(dim=20, classes=4, hidden=64, updater=None, seed=123):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater or Adam(1e-2))
+        .weight_init("xavier")
+        .list()
+        .layer(Dense(n_in=dim, n_out=hidden, activation="relu"))
+        .layer(Dense(n_out=hidden, activation="relu"))
+        .layer(Output(n_out=classes, activation="softmax", loss="mcxent"))
+        .build()
+    )
+
+
+class TestInit:
+    def test_shape_inference_via_input_type(self):
+        conf = (
+            NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Dense(n_out=32, activation="relu"))
+            .layer(Output(n_out=10, activation="softmax"))
+            .set_input_type(InputType.feed_forward(784))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        assert net.params["layer_0"]["W"].shape == (784, 32)
+        assert net.params["layer_1"]["W"].shape == (32, 10)
+
+    def test_num_params(self):
+        net = MultiLayerNetwork(build_mlp()).init()
+        expected = 20 * 64 + 64 + 64 * 64 + 64 + 64 * 4 + 4
+        assert net.num_params() == expected
+
+    def test_init_deterministic_by_seed(self):
+        n1 = MultiLayerNetwork(build_mlp(seed=7)).init()
+        n2 = MultiLayerNetwork(build_mlp(seed=7)).init()
+        np.testing.assert_array_equal(
+            np.asarray(n1.params["layer_0"]["W"]),
+            np.asarray(n2.params["layer_0"]["W"]))
+
+
+class TestTraining:
+    def test_fit_learns_blobs(self):
+        x, y = make_blobs()
+        net = MultiLayerNetwork(build_mlp()).init()
+        listener = CollectScoresIterationListener()
+        net.set_listeners(listener)
+        it = ArrayDataSetIterator(x, y, batch_size=64, shuffle=True, seed=1)
+        net.fit(it, epochs=30)
+        ev = net.evaluate(DataSet(x, y))
+        assert ev.accuracy() > 0.95, ev.stats()
+        scores = [s for _, s in listener.scores]
+        assert scores[-1] < scores[0] * 0.5
+
+    def test_fit_with_sgd_and_nesterov(self):
+        x, y = make_blobs(n=256)
+        for upd in (Sgd(0.1), Nesterovs(0.05, 0.9)):
+            net = MultiLayerNetwork(build_mlp(updater=upd)).init()
+            net.fit(x, y, epochs=30, batch_size=64)
+            assert net.evaluate(DataSet(x, y)).accuracy() > 0.9
+
+    def test_output_shape_and_probs(self):
+        net = MultiLayerNetwork(build_mlp()).init()
+        out = np.asarray(net.output(np.zeros((5, 20), np.float32)))
+        assert out.shape == (5, 4)
+        np.testing.assert_allclose(out.sum(axis=1), np.ones(5), rtol=1e-5)
+
+    def test_feed_forward_returns_all_activations(self):
+        net = MultiLayerNetwork(build_mlp()).init()
+        acts = net.feed_forward(np.zeros((3, 20), np.float32))
+        assert len(acts) == 3
+        assert acts[0].shape == (3, 64)
+        assert acts[-1].shape == (3, 4)
+
+    def test_score_decreases(self):
+        x, y = make_blobs(n=128)
+        ds = DataSet(x, y)
+        net = MultiLayerNetwork(build_mlp()).init()
+        before = net.score(ds)
+        net.fit(x, y, epochs=20, batch_size=32)
+        after = net.score(ds)
+        assert after < before * 0.5
+
+
+class TestSerializationRoundTrip:
+    def test_json_roundtrip_preserves_config(self):
+        conf = build_mlp()
+        s = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert conf2 == conf
+
+    def test_json_roundtrip_trains_identically(self):
+        x, y = make_blobs(n=64)
+        conf = build_mlp()
+        net1 = MultiLayerNetwork(conf).init()
+        net2 = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(conf.to_json())).init()
+        net1.fit(x, y, epochs=2, batch_size=32, async_prefetch=False)
+        net2.fit(x, y, epochs=2, batch_size=32, async_prefetch=False)
+        np.testing.assert_allclose(
+            np.asarray(net1.params["layer_0"]["W"]),
+            np.asarray(net2.params["layer_0"]["W"]), atol=1e-6)
+
+
+class TestRegularizationAndDropout:
+    def test_l2_shrinks_weights(self):
+        x, y = make_blobs(n=128)
+        conf_plain = build_mlp()
+        conf_l2 = (
+            NeuralNetConfiguration.builder().seed(123).updater(Adam(1e-2))
+            .l2(0.5).list()
+            .layer(Dense(n_in=20, n_out=64, activation="relu"))
+            .layer(Dense(n_out=64, activation="relu"))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build()
+        )
+        n1 = MultiLayerNetwork(conf_plain).init()
+        n2 = MultiLayerNetwork(conf_l2).init()
+        n1.fit(x, y, epochs=10, batch_size=64)
+        n2.fit(x, y, epochs=10, batch_size=64)
+        w1 = float(jnp.linalg.norm(n1.params["layer_0"]["W"]))
+        w2 = float(jnp.linalg.norm(n2.params["layer_0"]["W"]))
+        assert w2 < w1
+
+    def test_dropout_trains(self):
+        x, y = make_blobs(n=256)
+        conf = (
+            NeuralNetConfiguration.builder().seed(123).updater(Adam(1e-2))
+            .list()
+            .layer(Dense(n_in=20, n_out=64, activation="relu", dropout=0.3))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=20, batch_size=64)
+        assert net.evaluate(DataSet(x, y)).accuracy() > 0.85
+
+    def test_dropout_inference_deterministic(self):
+        conf = (
+            NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Dense(n_in=8, n_out=16, dropout=0.5, activation="tanh"))
+            .layer(Output(n_out=2, activation="softmax"))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+        o1 = np.asarray(net.output(x))
+        o2 = np.asarray(net.output(x))
+        np.testing.assert_array_equal(o1, o2)
+
+
+class TestReviewRegressions:
+    def test_output_has_bias_false_honored(self):
+        conf = (
+            NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Dense(n_in=6, n_out=4, activation="tanh"))
+            .layer(Output(n_out=2, activation="softmax", has_bias=False))
+            .build())
+        net = MultiLayerNetwork(conf).init()
+        assert "b" not in net.params["layer_1"]
+        out = np.asarray(net.output(np.zeros((2, 6), np.float32)))
+        assert out.shape == (2, 2)
+
+    def test_output_train_mode_with_dropout(self):
+        conf = (
+            NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Dense(n_in=6, n_out=8, dropout=0.5, activation="relu"))
+            .layer(Output(n_out=2, activation="softmax"))
+            .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        o1 = np.asarray(net.output(x, train=True))
+        o2 = np.asarray(net.output(x, train=True))
+        # train-mode inference works and uses fresh dropout masks each call
+        assert o1.shape == (4, 2)
+        assert not np.allclose(o1, o2)

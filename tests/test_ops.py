@@ -1,0 +1,111 @@
+"""Unit tests for activations, losses, initializers (the ND4J-parity op
+sets; SURVEY.md §1 L0)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import activations, initializers, losses
+
+
+class TestActivations:
+    def test_registry_complete(self):
+        # The reference's Activation enum surface (ND4J, as consumed by DL4J)
+        required = {"cube", "elu", "hardsigmoid", "hardtanh", "identity",
+                    "leakyrelu", "rationaltanh", "relu", "rrelu", "sigmoid",
+                    "softmax", "softplus", "softsign", "tanh",
+                    "rectifiedtanh", "selu", "swish", "gelu"}
+        assert required.issubset(set(activations.names()))
+
+    def test_values(self):
+        x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0], jnp.float32)
+        np.testing.assert_allclose(activations.get("relu")(x),
+                                   [0, 0, 0, 0.5, 2.0])
+        np.testing.assert_allclose(activations.get("identity")(x), x)
+        np.testing.assert_allclose(activations.get("hardtanh")(x),
+                                   [-1, -0.5, 0, 0.5, 1])
+        np.testing.assert_allclose(activations.get("cube")(x),
+                                   [-8, -0.125, 0, 0.125, 8])
+        s = activations.get("sigmoid")(x)
+        np.testing.assert_allclose(np.asarray(s), 1 / (1 + np.exp(-np.asarray(x))),
+                                   rtol=1e-6)
+
+    def test_softmax_rows_sum_to_one(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 10))
+        y = activations.get("softmax")(x)
+        np.testing.assert_allclose(jnp.sum(y, axis=-1), np.ones(4), rtol=1e-6)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activations.get("nope")
+
+
+class TestLosses:
+    def test_mcxent_matches_manual(self):
+        labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        preout = jnp.array([[2.0, 1.0], [0.5, 1.5]])
+        score = losses.get("mcxent").score(
+            labels, preout, activations.get("softmax"))
+        p = jax.nn.softmax(preout, axis=-1)
+        manual = -np.mean(np.log(np.asarray(p)[[0, 1], [0, 1]]))
+        np.testing.assert_allclose(float(score), manual, rtol=1e-6)
+
+    def test_mse_matches_manual(self):
+        labels = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        preout = jnp.array([[1.5, 2.5], [2.0, 5.0]])
+        score = losses.get("mse").score(labels, preout,
+                                        activations.get("identity"))
+        manual = np.mean(np.mean((np.asarray(preout) - np.asarray(labels)) ** 2,
+                                 axis=1))
+        np.testing.assert_allclose(float(score), manual, rtol=1e-6)
+
+    def test_xent_stable_form_matches_naive(self):
+        labels = jnp.array([[1.0, 0.0, 1.0]])
+        preout = jnp.array([[3.0, -2.0, 0.1]])
+        stable = losses.get("xent").score(labels, preout,
+                                          activations.get("sigmoid"))
+        p = np.asarray(jax.nn.sigmoid(preout))
+        naive = -np.sum(np.asarray(labels) * np.log(p)
+                        + (1 - np.asarray(labels)) * np.log(1 - p))
+        np.testing.assert_allclose(float(stable), naive, rtol=1e-5)
+
+    def test_masked_score_ignores_masked_rows(self):
+        labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        preout = jnp.array([[2.0, 1.0], [100.0, -100.0]])
+        mask = jnp.array([1.0, 0.0])
+        score = losses.get("mcxent").score(
+            labels, preout, activations.get("softmax"), mask=mask)
+        score_only_first = losses.get("mcxent").score(
+            labels[:1], preout[:1], activations.get("softmax"))
+        np.testing.assert_allclose(float(score), float(score_only_first),
+                                   rtol=1e-6)
+
+    def test_registry_complete(self):
+        required = {"mcxent", "negativeloglikelihood", "mse", "l1", "l2",
+                    "xent", "hinge", "squaredhinge", "kldivergence", "mae",
+                    "mape", "msle", "poisson", "cosineproximity"}
+        assert required.issubset(set(losses.names()))
+
+
+class TestInitializers:
+    def test_xavier_std(self):
+        key = jax.random.PRNGKey(0)
+        w = initializers.get("xavier")(key, (500, 400), 500, 400, jnp.float32)
+        expected_std = np.sqrt(2.0 / 900)
+        assert abs(float(jnp.std(w)) - expected_std) < 0.05 * expected_std
+
+    def test_zero(self):
+        w = initializers.get("zero")(jax.random.PRNGKey(0), (3, 3), 3, 3)
+        assert float(jnp.sum(jnp.abs(w))) == 0.0
+
+    def test_uniform_bounds(self):
+        key = jax.random.PRNGKey(1)
+        w = initializers.get("uniform")(key, (100, 100), 100, 100, jnp.float32)
+        a = 1.0 / np.sqrt(100)
+        assert float(jnp.max(w)) <= a and float(jnp.min(w)) >= -a
+
+    def test_distribution(self):
+        fn = initializers.distribution({"type": "normal", "mean": 5.0, "std": 0.1})
+        w = fn(jax.random.PRNGKey(0), (1000,), 1000, 1, jnp.float32)
+        assert abs(float(jnp.mean(w)) - 5.0) < 0.05

@@ -1,0 +1,113 @@
+"""Distributed-training semantics tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's pinned distributed semantics (SURVEY.md §4):
+TestCompareParameterAveragingSparkVsSingleMachine — with fixed seeds and
+averaging_frequency=1, distributed training must match single-machine
+training; plus sharded-step equivalence (the performance path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+from tests.test_multilayer import build_mlp, make_blobs
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_step_matches_single_device():
+    """The data-parallel sharded train step must produce the same params as
+    the single-device step on identical batches (modulo float reduction
+    order)."""
+    x, y = make_blobs(n=256, seed=3)
+    net_single = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    net_sharded = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    mesh = make_mesh({"data": 8})
+    net_sharded.use_mesh(mesh)
+
+    it1 = ArrayDataSetIterator(x, y, batch_size=64)
+    it2 = ArrayDataSetIterator(x, y, batch_size=64)
+    net_single.fit(it1, epochs=3, async_prefetch=False)
+    net_sharded.fit(it2, epochs=3, async_prefetch=False)
+
+    w1 = np.asarray(net_single.params["layer_0"]["W"])
+    w2 = np.asarray(net_sharded.params["layer_0"]["W"])
+    np.testing.assert_allclose(w1, w2, rtol=2e-4, atol=1e-5)
+
+
+def test_parameter_averaging_freq1_equals_larger_batch():
+    """averagingFrequency=1 with N workers on batch b == single training on
+    batch N*b (the reference's pinned Spark-vs-single-machine semantics),
+    exactly, given SGD and identical data order."""
+    x, y = make_blobs(n=128, seed=5)
+    workers = 4
+    small_b, big_b = 16, 64
+
+    net_pw = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    wrapper = ParallelWrapper(net_pw, workers=workers, averaging_frequency=1)
+    wrapper.fit(ArrayDataSetIterator(x, y, batch_size=small_b), epochs=2)
+
+    net_big = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    net_big.fit(ArrayDataSetIterator(x, y, batch_size=big_b), epochs=2,
+                async_prefetch=False)
+
+    w1 = np.asarray(net_pw.params["layer_0"]["W"])
+    w2 = np.asarray(net_big.params["layer_0"]["W"])
+    np.testing.assert_allclose(w1, w2, rtol=1e-5, atol=1e-6)
+
+
+def test_parameter_averaging_converges():
+    x, y = make_blobs(n=256, seed=6)
+    net = MultiLayerNetwork(build_mlp()).init()
+    wrapper = ParallelWrapper(net, workers=2, averaging_frequency=4)
+    wrapper.fit(ArrayDataSetIterator(x, y, batch_size=32), epochs=20)
+    assert net.evaluate(DataSet(x, y)).accuracy() > 0.9
+
+
+def test_sharded_inference_matches():
+    x, _ = make_blobs(n=64, seed=7)
+    net = MultiLayerNetwork(build_mlp()).init()
+    out_single = np.asarray(net.output(x))
+    mesh = make_mesh({"data": 8})
+    net.use_mesh(mesh)
+    out_sharded = np.asarray(net.output(x))
+    np.testing.assert_allclose(out_single, out_sharded, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_step_partial_batch():
+    """Partial final batches (not divisible by mesh size) must train without
+    error and match the unsharded result (pad+mask path)."""
+    x, y = make_blobs(n=250, seed=11)  # 250 % 64 = 58, 58 % 8 != 0
+    net_single = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    net_sharded = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    net_sharded.use_mesh(make_mesh({"data": 8}))
+    net_single.fit(ArrayDataSetIterator(x, y, batch_size=64), epochs=2,
+                   async_prefetch=False)
+    net_sharded.fit(ArrayDataSetIterator(x, y, batch_size=64), epochs=2,
+                    async_prefetch=False)
+    np.testing.assert_allclose(
+        np.asarray(net_single.params["layer_0"]["W"]),
+        np.asarray(net_sharded.params["layer_0"]["W"]), rtol=2e-4, atol=1e-5)
+
+
+def test_parameter_averaging_short_data_not_diluted():
+    """A worker that never received a batch must not participate in the
+    average (1-batch iterator with 2 workers == plain single-worker step)."""
+    x, y = make_blobs(n=16, seed=12)
+    net_pw = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    ParallelWrapper(net_pw, workers=2, averaging_frequency=1).fit(
+        ArrayDataSetIterator(x, y, batch_size=16), epochs=1)
+    net_ref = MultiLayerNetwork(build_mlp(updater=Sgd(0.1))).init()
+    net_ref.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=1,
+                async_prefetch=False)
+    np.testing.assert_allclose(
+        np.asarray(net_pw.params["layer_0"]["W"]),
+        np.asarray(net_ref.params["layer_0"]["W"]), rtol=1e-6, atol=1e-7)
