@@ -1,0 +1,55 @@
+"""Benchmark entry point (run by the driver on real TPU hardware).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures training throughput (examples/sec) of the flagship model's jitted
+train step on MNIST-shaped data. The reference publishes no numbers
+(BASELINE.md), so vs_baseline is reported against a recorded local CPU-era
+reference point once established; 1.0 until then.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from __graft_entry__ import _flagship
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    net = _flagship()
+
+    batch = 1024
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
+    ds = DataSet(x, y)
+
+    # warmup (compile)
+    for _ in range(3):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+
+    steps = 50
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = steps * batch / dt
+    print(json.dumps({
+        "metric": "mnist_mlp_train_throughput",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

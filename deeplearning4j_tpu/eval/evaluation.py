@@ -64,7 +64,13 @@ class Evaluation:
         else:
             actual = labels.reshape(-1).astype(int)
             ncls = predictions.shape[1]
-        predicted = predictions.argmax(axis=1)
+        if predictions.shape[1] == 1:
+            # single-output binary head: threshold at 0.5 (Evaluation.java's
+            # binary path), two-class confusion matrix
+            predicted = (predictions.reshape(-1) > 0.5).astype(int)
+            ncls = 2
+        else:
+            predicted = predictions.argmax(axis=1)
         if self.confusion is None:
             self.num_classes = ncls
             self.confusion = ConfusionMatrix(ncls)

@@ -129,6 +129,7 @@ class Dense(FeedForwardLayerConfig):
     """Fully connected layer (DenseLayer.java parity)."""
 
     layer_type = "dense"
+    has_bias: bool = True
 
     def make_layer(self, input_type, global_conf, policy):
         from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer

@@ -75,7 +75,10 @@ class MultiLayerNetwork:
         self._rng_key = None
 
     # ------------------------------------------------------------------ init
-    def init(self, seed: Optional[int] = None):
+    def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
+        """Build runtime layers and parameter/optimizer pytrees. With
+        ``structure_only`` the trees are ShapeDtypeStructs (via jax.eval_shape)
+        — used by clone()/restore, which overwrite every leaf anyway."""
         gc = self.conf.global_conf
         seed = gc.seed if seed is None else seed
         self._rng_key = jax.random.PRNGKey(seed)
@@ -105,31 +108,54 @@ class MultiLayerNetwork:
             input_type = layer.output_type
         self._resolved_confs = resolved_confs
 
-        # init params + state
-        key = self._rng_key
-        params, state = {}, {}
-        for layer in self.layers:
-            key, sub = jax.random.split(key)
-            p = layer.init_params(sub)
-            if p:
-                params[layer.name] = p
-            s = layer.init_state()
-            if s:
-                state[layer.name] = s
-        self.params = params
-        self.state = state
+        # init params + state + per-layer optimizer state
+        def init_trees(key):
+            params, state = {}, {}
+            for layer in self.layers:
+                key_, sub = jax.random.split(key)
+                key = key_
+                p = layer.init_params(sub)
+                if p:
+                    params[layer.name] = p
+                s = layer.init_state()
+                if s:
+                    state[layer.name] = s
+            opt_state = {}
+            for layer in self.layers:
+                if layer.name in params:
+                    upd = layer.resolve("updater")
+                    opt_state[layer.name] = upd.init_state(params[layer.name])
+            return params, state, opt_state
 
-        # per-layer optimizer state
-        opt_state = {}
-        for layer in self.layers:
-            if layer.name in params:
-                upd = layer.resolve("updater")
-                opt_state[layer.name] = upd.init_state(params[layer.name])
-        self.opt_state = opt_state
+        if structure_only:
+            self.params, self.state, self.opt_state = jax.eval_shape(
+                init_trees, self._rng_key)
+        else:
+            self.params, self.state, self.opt_state = init_trees(self._rng_key)
         self.iteration = 0
         self._train_step = None
         self._apply_fns = {}
         return self
+
+    def materialize_state(self):
+        """Concrete layer state (e.g. BN running stats) — used after a
+        structure-only init when a checkpoint lacks the state tree."""
+        state = {}
+        for layer in self.layers:
+            s = layer.init_state()
+            if s:
+                state[layer.name] = s
+        self.state = state
+
+    def materialize_opt_state(self):
+        """Fresh optimizer state from (concrete) params — used after a
+        structure-only init when the updater state isn't being restored."""
+        opt_state = {}
+        for layer in self.layers:
+            if layer.name in self.params:
+                upd = layer.resolve("updater")
+                opt_state[layer.name] = upd.init_state(self.params[layer.name])
+        self.opt_state = opt_state
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -373,7 +399,7 @@ class MultiLayerNetwork:
         donates its input buffers, so an aliasing clone would be invalidated
         by the next fit_batch on either net."""
         net = MultiLayerNetwork(self.conf)
-        net.init()
+        net.init(structure_only=True)
         net.params = jax.tree_util.tree_map(jnp.copy, self.params)
         net.state = jax.tree_util.tree_map(jnp.copy, self.state)
         net.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)

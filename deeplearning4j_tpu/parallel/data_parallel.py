@@ -156,9 +156,16 @@ class ParallelWrapper:
                 # here a tree-mean (idle tail workers are excluded so the
                 # last partial round isn't diluted toward stale params).
                 active = [replicas[w] for w in range(self.workers) if stepped[w]]
+                def mean_leaf(*xs):
+                    # Integer leaves (e.g. Adam's step counter 't') must stay
+                    # integral: true-division would silently float them and
+                    # retrace the donated jitted step. Max = the furthest
+                    # worker's count, exact when workers step evenly.
+                    if jnp.issubdtype(xs[0].dtype, jnp.integer):
+                        return jnp.max(jnp.stack(xs), axis=0)
+                    return sum(xs) / len(xs)
                 def tree_mean(trees):
-                    return jax.tree_util.tree_map(
-                        lambda *xs: sum(xs) / len(xs), *trees)
+                    return jax.tree_util.tree_map(mean_leaf, *trees)
                 net.params = tree_mean([r[0] for r in active])
                 net.state = active[0][1]
                 if self.average_updaters:

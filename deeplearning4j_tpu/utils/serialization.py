@@ -52,8 +52,7 @@ def _npz_bytes_to_leaves(data: bytes, template) -> object:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def write_model(net, path, save_updater: bool = True):
-    """ModelSerializer.writeModel parity."""
+def _write(net, path, model_type: str, save_updater: bool):
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", net.conf.to_json())
         zf.writestr("coefficients.npz", _tree_to_npz_bytes(net.params))
@@ -63,10 +62,39 @@ def write_model(net, path, save_updater: bool = True):
             zf.writestr("updaterState.npz", _tree_to_npz_bytes(net.opt_state))
         zf.writestr("metadata.json", json.dumps({
             "format_version": _FORMAT_VERSION,
-            "model_type": "multi_layer_network",
+            "model_type": model_type,
             "iteration": int(net.iteration),
             "epoch": int(net.epoch),
         }))
+
+
+def _restore(path, build_net, load_updater: bool):
+    """Shared restore: ``build_net(conf_json) -> net`` initialized
+    structure-only; trees not present in the file are materialized fresh."""
+    with zipfile.ZipFile(path, "r") as zf:
+        net = build_net(zf.read("configuration.json").decode("utf-8"))
+        names = set(zf.namelist())
+        net.params = _npz_bytes_to_leaves(zf.read("coefficients.npz"),
+                                          net.params)
+        if "state.npz" in names and net.state:
+            net.state = _npz_bytes_to_leaves(zf.read("state.npz"), net.state)
+        else:
+            net.materialize_state()
+        if load_updater and "updaterState.npz" in names:
+            net.opt_state = _npz_bytes_to_leaves(zf.read("updaterState.npz"),
+                                                 net.opt_state)
+        else:
+            net.materialize_opt_state()
+        if "metadata.json" in names:
+            meta = json.loads(zf.read("metadata.json"))
+            net.iteration = meta.get("iteration", 0)
+            net.epoch = meta.get("epoch", 0)
+    return net
+
+
+def write_model(net, path, save_updater: bool = True):
+    """ModelSerializer.writeModel parity."""
+    _write(net, path, "multi_layer_network", save_updater)
 
 
 def restore_multi_layer_network(path, load_updater: bool = True):
@@ -74,23 +102,15 @@ def restore_multi_layer_network(path, load_updater: bool = True):
     from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    with zipfile.ZipFile(path, "r") as zf:
-        conf = MultiLayerConfiguration.from_json(
-            zf.read("configuration.json").decode("utf-8"))
-        net = MultiLayerNetwork(conf).init()
-        net.params = _npz_bytes_to_leaves(zf.read("coefficients.npz"),
-                                          net.params)
-        names = set(zf.namelist())
-        if "state.npz" in names and net.state:
-            net.state = _npz_bytes_to_leaves(zf.read("state.npz"), net.state)
-        if load_updater and "updaterState.npz" in names:
-            net.opt_state = _npz_bytes_to_leaves(zf.read("updaterState.npz"),
-                                                 net.opt_state)
-        if "metadata.json" in names:
-            meta = json.loads(zf.read("metadata.json"))
-            net.iteration = meta.get("iteration", 0)
-            net.epoch = meta.get("epoch", 0)
-    return net
+    def build(conf_json):
+        conf = MultiLayerConfiguration.from_json(conf_json)
+        return MultiLayerNetwork(conf).init(structure_only=True)
+
+    return _restore(path, build, load_updater)
+
+
+def write_computation_graph(net, path, save_updater: bool = True):
+    _write(net, path, "computation_graph", save_updater)
 
 
 def restore_computation_graph(path, load_updater: bool = True):
@@ -103,36 +123,8 @@ def restore_computation_graph(path, load_updater: bool = True):
         raise NotImplementedError(
             "ComputationGraph is not available yet in this build") from e
 
-    with zipfile.ZipFile(path, "r") as zf:
-        conf = ComputationGraphConfiguration.from_json(
-            zf.read("configuration.json").decode("utf-8"))
-        net = ComputationGraph(conf).init()
-        net.params = _npz_bytes_to_leaves(zf.read("coefficients.npz"),
-                                          net.params)
-        names = set(zf.namelist())
-        if "state.npz" in names and net.state:
-            net.state = _npz_bytes_to_leaves(zf.read("state.npz"), net.state)
-        if load_updater and "updaterState.npz" in names:
-            net.opt_state = _npz_bytes_to_leaves(zf.read("updaterState.npz"),
-                                                 net.opt_state)
-        if "metadata.json" in names:
-            meta = json.loads(zf.read("metadata.json"))
-            net.iteration = meta.get("iteration", 0)
-            net.epoch = meta.get("epoch", 0)
-    return net
+    def build(conf_json):
+        conf = ComputationGraphConfiguration.from_json(conf_json)
+        return ComputationGraph(conf).init(structure_only=True)
 
-
-def write_computation_graph(net, path, save_updater: bool = True):
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", net.conf.to_json())
-        zf.writestr("coefficients.npz", _tree_to_npz_bytes(net.params))
-        if net.state:
-            zf.writestr("state.npz", _tree_to_npz_bytes(net.state))
-        if save_updater and net.opt_state is not None:
-            zf.writestr("updaterState.npz", _tree_to_npz_bytes(net.opt_state))
-        zf.writestr("metadata.json", json.dumps({
-            "format_version": _FORMAT_VERSION,
-            "model_type": "computation_graph",
-            "iteration": int(net.iteration),
-            "epoch": int(net.epoch),
-        }))
+    return _restore(path, build, load_updater)
