@@ -9,3 +9,5 @@ from deeplearning4j_tpu.nn.conf.core import (
     ListBuilder,
 )
 from deeplearning4j_tpu.nn.conf import layers
+from deeplearning4j_tpu.nn.conf import layers_conv
+from deeplearning4j_tpu.nn.conf import layers_recurrent

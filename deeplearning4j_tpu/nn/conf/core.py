@@ -183,6 +183,16 @@ class MultiLayerConfiguration:
     tbptt_bwd_length: int = 20
     preprocessors: dict = field(default_factory=dict)
 
+    def __post_init__(self):
+        if (self.backprop_type == "tbptt"
+                and self.tbptt_fwd_length != self.tbptt_bwd_length):
+            raise ValueError(
+                "tBPTT here chunks the sequence at tbptt_fwd_length and "
+                "truncates gradients at the chunk boundary, so "
+                f"tbptt_bwd_length ({self.tbptt_bwd_length}) must equal "
+                f"tbptt_fwd_length ({self.tbptt_fwd_length}); a shorter "
+                "backward window is not supported")
+
     def to_json(self) -> str:
         from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_to_dict
         return json.dumps(

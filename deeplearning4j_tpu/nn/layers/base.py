@@ -65,6 +65,12 @@ class Layer:
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         raise NotImplementedError
 
+    def feed_forward_mask(self, mask):
+        """Transform the per-timestep feature mask for downstream layers
+        (Layer.feedForwardMaskArray parity). Time-shrinking layers override;
+        layers that collapse the time axis return None."""
+        return mask
+
     def _input_dropout(self, x, train, rng):
         """Per-layer input dropout (reference: conf.dropOut applied to layer
         input). ``dropout`` here is the DROP probability; inverted-dropout

@@ -25,11 +25,7 @@ class DenseLayer(Layer):
 
     def init_params(self, key):
         fan_in, fan_out = self._fans()
-        wi = self.resolve("weight_init", "xavier")
-        if isinstance(wi, dict):
-            w_fn = init_mod.distribution(wi)
-        else:
-            w_fn = init_mod.get(wi)
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
         k_w, _ = jax.random.split(key)
         W = w_fn(k_w, (fan_in, fan_out), fan_in, fan_out, self.param_dtype)
         params = {"W": W}
@@ -104,8 +100,7 @@ class EmbeddingLayerImpl(Layer):
 
     def init_params(self, key):
         n_in, n_out = self.conf.n_in, self.conf.n_out
-        wi = self.resolve("weight_init", "xavier")
-        w_fn = init_mod.distribution(wi) if isinstance(wi, dict) else init_mod.get(wi)
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
         W = w_fn(key, (n_in, n_out), n_in, n_out, self.param_dtype)
         params = {"W": W}
         if getattr(self.conf, "has_bias", True):

@@ -1,0 +1,68 @@
+"""Normalization runtime layers: batch norm + LRN.
+
+Parity: nn/layers/normalization/BatchNormalization.java (batch statistics
+during training, global moving mean/var for inference, helper seam at
+:53-60) and LocalResponseNormalization.java. The cuDNN helper path maps to
+the op registry; moving statistics live in the layer *state* pytree (updated
+functionally inside the jitted train step, not mutated in place).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.ops import registry as ops
+
+
+class BatchNormLayer(Layer):
+    def _num_features(self):
+        it = self.input_type
+        if it is None:
+            raise ValueError("BatchNorm requires an input_type for init")
+        if it.kind == "convolutional":
+            return it.channels
+        return it.flat_size()
+
+    def init_params(self, key):
+        if self.conf.lock_gamma_beta:
+            return {}
+        f = self._num_features()
+        return {
+            "gamma": jnp.full((f,), float(self.conf.gamma), self.param_dtype),
+            "beta": jnp.full((f,), float(self.conf.beta), self.param_dtype),
+        }
+
+    def init_state(self):
+        f = self._num_features()
+        return {
+            "mean": jnp.zeros((f,), self.param_dtype),
+            "var": jnp.ones((f,), self.param_dtype),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        c = self.conf
+        axes = tuple(range(x.ndim - 1))  # all but the feature/channel axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = c.decay
+            new_state = {
+                "mean": d * state["mean"] + (1 - d) * mean,
+                "var": d * state["var"] + (1 - d) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = {}
+        xhat = (x - mean) / jnp.sqrt(var + c.eps)
+        if params:
+            xhat = xhat * params["gamma"] + params["beta"]
+        else:
+            xhat = xhat * c.gamma + c.beta
+        return self.activation_fn(xhat), new_state
+
+
+class LRNLayer(Layer):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        c = self.conf
+        return ops.get("lrn")(x, k=c.k, n=c.n, alpha=c.alpha, beta=c.beta), state

@@ -1,0 +1,60 @@
+"""Global pooling runtime layer.
+
+Parity: nn/layers/pooling/GlobalPoolingLayer.java — mask-aware global
+max/avg/sum/pnorm over the time dimension ([b, t, f]) or spatial dimensions
+([b, h, w, c]); masking semantics follow util/MaskedReductionUtil.java.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+
+
+class GlobalPoolingLayerImpl(Layer):
+    def feed_forward_mask(self, mask):
+        return None
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        c = self.conf
+        if x.ndim == 3:      # [b, t, f] — pool over time, mask-aware
+            axes = (1,)
+            m = None
+            if mask is not None:
+                m = mask.reshape(mask.shape[0], -1)[:, :, None].astype(x.dtype)
+        elif x.ndim == 4:    # [b, h, w, c] — pool over space
+            axes = (1, 2)
+            m = None
+        else:
+            raise ValueError(
+                f"GlobalPooling expects 3d or 4d input, got shape {x.shape}")
+
+        if m is None:
+            if c.pooling == "max":
+                y = jnp.max(x, axis=axes)
+            elif c.pooling == "avg":
+                y = jnp.mean(x, axis=axes)
+            elif c.pooling == "sum":
+                y = jnp.sum(x, axis=axes)
+            elif c.pooling == "pnorm":
+                y = jnp.sum(jnp.abs(x) ** c.pnorm, axis=axes) ** (1.0 / c.pnorm)
+            else:
+                raise ValueError(f"Unknown pooling type: {c.pooling}")
+            return y, state
+
+        # masked time-series reductions (MaskedReductionUtil parity)
+        if c.pooling == "max":
+            neg = jnp.finfo(x.dtype).min
+            y = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+        elif c.pooling == "avg":
+            denom = jnp.maximum(jnp.sum(m, axis=1), 1e-8)
+            y = jnp.sum(x * m, axis=1) / denom
+        elif c.pooling == "sum":
+            y = jnp.sum(x * m, axis=1)
+        elif c.pooling == "pnorm":
+            s = jnp.sum(jnp.abs(x * m) ** c.pnorm, axis=1)
+            y = s ** (1.0 / c.pnorm)
+        else:
+            raise ValueError(f"Unknown pooling type: {c.pooling}")
+        return y, state

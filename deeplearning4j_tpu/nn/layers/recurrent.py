@@ -1,0 +1,208 @@
+"""Recurrent runtime layers: Graves LSTM (+bidirectional), RNN output head,
+last-time-step extraction.
+
+Parity: nn/layers/recurrent/{GravesLSTM, GravesBidirectionalLSTM,
+LSTMHelpers, RnnOutputLayer, BaseRecurrentLayer}.java. The reference's
+hand-written per-timestep Java loop (LSTMHelpers.activateHelper :57 looping
+:76; backprop :271) becomes a ``lax.scan`` whose backward pass is derived by
+autodiff; the whole sequence compiles into the train step.
+
+Gate math (LSTMHelpers parity, Graves formulation with peepholes):
+    i = gate_act(x Wx_i + h Wh_i + p_i * c_prev + b_i)
+    f = gate_act(x Wx_f + h Wh_f + p_f * c_prev + b_f)
+    g = act(x Wx_g + h Wh_g + b_g)
+    c = f * c_prev + i * g
+    o = gate_act(x Wx_o + h Wh_o + p_o * c + b_o)
+    h = o * act(c)
+
+Masking: masked timesteps carry (h, c) through unchanged and emit zero
+output (per-timestep masking semantics, GradientCheckTestsMasking parity).
+
+Streaming (`rnnTimeStep` :2234 / BaseRecurrentLayer stateMap parity): when
+``layer.streaming`` is set by the network, the final (h, c) carry is read
+from / written to the layer's state subtree under "h"/"c" — used by
+``MultiLayerNetwork.rnn_time_step`` and truncated BPTT.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.ops import activations as act_mod
+from deeplearning4j_tpu.ops import initializers as init_mod
+from deeplearning4j_tpu.ops import losses as losses_mod
+
+CARRY_KEYS = ("h", "c", "h_bwd", "c_bwd")
+
+
+def _lstm_scan(params, x, h0, c0, mask, gate_act, cell_act):
+    """Scan an LSTM over [b, t, f]; returns (y [b,t,n], hT, cT)."""
+    n = params["b"].shape[0] // 4
+    p_i = params["p"][0]
+    p_f = params["p"][1]
+    p_o = params["p"][2]
+
+    # project the whole sequence's input contribution in one MXU matmul
+    xz = jnp.einsum("btf,fg->btg", x, params["Wx"]) + params["b"]
+    xz_t = jnp.moveaxis(xz, 1, 0)  # [t, b, 4n]
+    mask_t = None if mask is None else jnp.moveaxis(mask, 1, 0)  # [t, b]
+
+    def cell(carry, inp):
+        h_prev, c_prev = carry
+        if mask_t is None:
+            z = inp
+            m = None
+        else:
+            z, m = inp
+        z = z + h_prev @ params["Wh"]
+        zi, zf, zo, zg = (z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n],
+                          z[:, 3 * n:])
+        i = gate_act(zi + p_i * c_prev)
+        f = gate_act(zf + p_f * c_prev)
+        g = cell_act(zg)
+        c = f * c_prev + i * g
+        o = gate_act(zo + p_o * c)
+        h = o * cell_act(c)
+        if m is not None:
+            mcol = m[:, None]
+            h_keep = jnp.where(mcol > 0, h, h_prev)
+            c_keep = jnp.where(mcol > 0, c, c_prev)
+            return (h_keep, c_keep), h * mcol
+        return (h, c), h
+
+    xs = xz_t if mask_t is None else (xz_t, mask_t)
+    (hT, cT), ys = jax.lax.scan(cell, (h0, c0), xs)
+    return jnp.moveaxis(ys, 0, 1), hT, cT
+
+
+class GravesLSTMLayer(Layer):
+    is_recurrent_stateful = True
+    streaming = False
+
+    def _init_direction(self, key):
+        n_in, n = self.conf.n_in, self.conf.n_out
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
+        k1, k2 = jax.random.split(key)
+        Wx = w_fn(k1, (n_in, 4 * n), n_in, n, self.param_dtype)
+        Wh = w_fn(k2, (n, 4 * n), n, n, self.param_dtype)
+        b = jnp.zeros((4 * n,), self.param_dtype)
+        # forget-gate bias init (gate order i, f, o, g)
+        b = b.at[n:2 * n].set(float(self.conf.forget_gate_bias_init))
+        p = jnp.zeros((3, n), self.param_dtype)
+        return {"Wx": Wx, "Wh": Wh, "b": b, "p": p}
+
+    def init_params(self, key):
+        return self._init_direction(key)
+
+    @property
+    def gate_fn(self):
+        return act_mod.get(self.conf.gate_activation)
+
+    def _run(self, params, x, mask, carry, reverse=False):
+        n = self.conf.n_out
+        b = x.shape[0]
+        if carry is None:
+            h0 = jnp.zeros((b, n), self.param_dtype)
+            c0 = jnp.zeros((b, n), self.param_dtype)
+        else:
+            h0, c0 = carry
+        if reverse:
+            x = jnp.flip(x, axis=1)
+            mask = None if mask is None else jnp.flip(mask, axis=1)
+        y, hT, cT = _lstm_scan(params, x, h0, c0, mask, self.gate_fn,
+                               self.activation_fn)
+        if reverse:
+            y = jnp.flip(y, axis=1)
+        return y, hT, cT
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng).astype(self.param_dtype)
+        m = None
+        if mask is not None:
+            m = mask.reshape(mask.shape[0], -1).astype(x.dtype)
+        carry = None
+        if self.streaming and "h" in state:
+            carry = (state["h"], state["c"])
+        y, hT, cT = self._run(params, x, m, carry)
+        new_state = dict(state)
+        if self.streaming:
+            new_state["h"] = hT
+            new_state["c"] = cT
+        return y, new_state
+
+
+class GravesBidirectionalLSTMLayer(GravesLSTMLayer):
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"fwd": self._init_direction(k1),
+                "bwd": self._init_direction(k2)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.streaming:
+            raise ValueError(
+                "rnnTimeStep/tBPTT streaming is undefined for bidirectional "
+                "LSTM (the backward pass needs the full sequence) — matching "
+                "the reference's restriction")
+        x = self._input_dropout(x, train, rng).astype(self.param_dtype)
+        m = None
+        if mask is not None:
+            m = mask.reshape(mask.shape[0], -1).astype(x.dtype)
+        y_f, _, _ = self._run(params["fwd"], x, m, None)
+        y_b, _, _ = self._run(params["bwd"], x, m, None, reverse=True)
+        # reference sums directions (GravesBidirectionalLSTM.java:206)
+        return y_f + y_b, state
+
+
+class RnnOutputLayerImpl(Layer):
+    """Per-timestep dense + loss (RnnOutputLayer.java parity)."""
+
+    def init_params(self, key):
+        n_in, n_out = self.conf.n_in, self.conf.n_out
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
+        params = {"W": w_fn(key, (n_in, n_out), n_in, n_out, self.param_dtype)}
+        if self.conf.has_bias:
+            params["b"] = jnp.full(
+                (n_out,), float(self.resolve("bias_init", 0.0)),
+                self.param_dtype)
+        return params
+
+    @property
+    def loss_fn(self) -> losses_mod.Loss:
+        return losses_mod.get(self.conf.loss)
+
+    def preout(self, params, x):
+        cd = self.compute_dtype
+        z = jnp.einsum("btf,fg->btg", x.astype(cd), params["W"].astype(cd))
+        if "b" in params:
+            z = z + params["b"].astype(cd)
+        return z.astype(self.param_dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        return self.activation_fn(self.preout(params, x)), state
+
+    def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        z = self.preout(params, x)
+        n_out = z.shape[-1]
+        z2 = z.reshape(-1, n_out)
+        labels2 = labels.reshape(-1, n_out)
+        m2 = None if mask is None else mask.reshape(-1)
+        return self.loss_fn.score(labels2, z2, self.activation_fn, m2)
+
+
+class LastTimeStepLayer(Layer):
+    """[b, t, f] -> [b, f]: last step, or last *unmasked* step per example
+    (LastTimeStepVertex.java parity)."""
+
+    def feed_forward_mask(self, mask):
+        return None
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if mask is None:
+            return x[:, -1, :], state
+        m = mask.reshape(mask.shape[0], -1)
+        idx = jnp.maximum(jnp.sum(m, axis=1).astype(jnp.int32) - 1, 0)
+        return x[jnp.arange(x.shape[0]), idx, :], state

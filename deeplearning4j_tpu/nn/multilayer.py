@@ -70,9 +70,11 @@ class MultiLayerNetwork:
         self.listeners: list = []
         self.score_value = None
         self._train_step = None
+        self._tbptt_step = None
         self._apply_fns = {}
         self._mesh = None
         self._rng_key = None
+        self._rnn_state = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
@@ -134,6 +136,7 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state = init_trees(self._rng_key)
         self.iteration = 0
         self._train_step = None
+        self._tbptt_step = None
         self._apply_fns = {}
         return self
 
@@ -172,6 +175,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
         self._mesh = (mesh, data_axis)
         self._train_step = None
+        self._tbptt_step = None
         self._apply_fns = {}
         apply_mesh(self, mesh, data_axis)
         return self
@@ -193,6 +197,7 @@ class MultiLayerNetwork:
             p = params.get(layer.name, {})
             s = state.get(layer.name, {})
             x, s_new = layer.apply(p, s, x, train=train, rng=lrng, mask=fmask)
+            fmask = layer.feed_forward_mask(fmask)
             if s_new:
                 new_state[layer.name] = s_new
             if collect:
@@ -266,9 +271,94 @@ class MultiLayerNetwork:
                 "Network not initialized — call net.init() before "
                 "fit()/output()/evaluate()")
 
+    # ------------------------------------------------ recurrent state helpers
+    def _set_streaming(self, flag: bool):
+        for layer in self.layers:
+            if getattr(layer, "is_recurrent_stateful", False):
+                layer.streaming = flag
+
+    def _strip_carries(self, state):
+        from deeplearning4j_tpu.nn.layers.recurrent import CARRY_KEYS
+        out = {}
+        for name, sub in state.items():
+            kept = {k: v for k, v in sub.items() if k not in CARRY_KEYS}
+            if kept:
+                out[name] = kept
+        return out
+
+    def rnn_clear_previous_state(self):
+        """Reset streaming decode state (rnnClearPreviousState parity)."""
+        self._rnn_state = None
+
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (MultiLayerNetwork.rnnTimeStep :2234):
+        feed one step [b, f] or a chunk [b, t, f]; recurrent layers carry
+        (h, c) across calls."""
+        self._require_init()
+        x = jnp.asarray(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        self._set_streaming(True)
+        try:
+            key = "stream"
+            if key not in self._apply_fns:
+                def fn(params, state, xx):
+                    return self._forward(params, state, xx, train=False,
+                                         rng=None)
+                self._apply_fns[key] = jax.jit(fn)
+            state_in = getattr(self, "_rnn_state", None)
+            if state_in is None:
+                state_in = self.state
+            out, new_state = self._apply_fns[key](self.params, state_in, x)
+            self._rnn_state = new_state
+        finally:
+            self._set_streaming(False)
+        return out[:, 0, :] if single else out
+
+    def _fit_tbptt(self, ds: DataSet):
+        """Truncated BPTT (doTruncatedBPTT :1119): split the time axis into
+        tbptt_fwd_length chunks; recurrent state carries across chunks inside
+        the compiled step (via the state pytree) and resets per batch."""
+        L = self.conf.tbptt_fwd_length
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        self._set_streaming(True)
+        try:
+            if getattr(self, "_tbptt_step", None) is None:
+                self._tbptt_step = self._build_train_step()
+            t_total = x.shape[1]
+            score = None
+            for start in range(0, t_total, L):
+                sl = slice(start, min(start + L, t_total))
+                self._rng_key, rng = jax.random.split(self._rng_key)
+                it = jnp.asarray(self.iteration, jnp.int32)
+                self.params, self.state, self.opt_state, score = \
+                    self._tbptt_step(
+                        self.params, self.state, self.opt_state, it,
+                        x[:, sl], y[:, sl],
+                        None if fmask is None else fmask[:, sl],
+                        None if lmask is None else lmask[:, sl],
+                        rng)
+            self.state = self._strip_carries(self.state)
+        finally:
+            self._set_streaming(False)
+        self.iteration += 1
+        self.score_value = score
+        self.last_batch_examples = ds.num_examples
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+        return score
+
     def fit_batch(self, ds: DataSet):
         """One optimization step on one minibatch (Model.fit parity)."""
         self._require_init()
+        if (self.conf.backprop_type == "tbptt"
+                and getattr(ds.features, "ndim", 0) == 3
+                and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+            return self._fit_tbptt(ds)
         if self._train_step is None:
             self._train_step = self._build_train_step()
         self._rng_key, rng = jax.random.split(self._rng_key)
@@ -313,9 +403,9 @@ class MultiLayerNetwork:
     def _get_apply(self, collect=False, train=False):
         key = (collect, train)
         if key not in self._apply_fns:
-            def apply_fn(params, state, x, rng):
+            def apply_fn(params, state, x, rng, fmask):
                 out, _ = self._forward(params, state, x, train=train, rng=rng,
-                                       collect=collect)
+                                       fmask=fmask, collect=collect)
                 return out
             self._apply_fns[key] = jax.jit(apply_fn)
         return self._apply_fns[key]
@@ -326,20 +416,23 @@ class MultiLayerNetwork:
         self._rng_key, rng = jax.random.split(self._rng_key)
         return rng
 
-    def output(self, x, train: bool = False):
+    def output(self, x, train: bool = False, mask=None):
         """Forward pass -> final layer activations
-        (MultiLayerNetwork.output :1512)."""
+        (MultiLayerNetwork.output :1512). ``mask`` is the per-timestep
+        features mask for variable-length sequences."""
         self._require_init()
         fn = self._get_apply(collect=False, train=train)
         return fn(self.params, self.state, jnp.asarray(x),
-                  self._inference_rng(train))
+                  self._inference_rng(train),
+                  None if mask is None else jnp.asarray(mask))
 
-    def feed_forward(self, x, train: bool = False) -> List[jnp.ndarray]:
+    def feed_forward(self, x, train: bool = False, mask=None) -> List[jnp.ndarray]:
         """All layer activations (feedForward :675)."""
         self._require_init()
         fn = self._get_apply(collect=True, train=train)
         return fn(self.params, self.state, jnp.asarray(x),
-                  self._inference_rng(train))
+                  self._inference_rng(train),
+                  None if mask is None else jnp.asarray(mask))
 
     def score(self, ds: DataSet, train: bool = False):
         """Loss on one dataset (MultiLayerNetwork.score parity)."""
@@ -359,7 +452,7 @@ class MultiLayerNetwork:
         if isinstance(iterator, DataSet):
             iterator = ListDataSetIterator([iterator])
         for ds in iterator:
-            out = np.asarray(self.output(ds.features))
+            out = np.asarray(self.output(ds.features, mask=ds.features_mask))
             ev.eval(ds.labels, out, mask=ds.labels_mask)
         return ev
 
@@ -369,7 +462,7 @@ class MultiLayerNetwork:
         if isinstance(iterator, DataSet):
             iterator = ListDataSetIterator([iterator])
         for ds in iterator:
-            out = np.asarray(self.output(ds.features))
+            out = np.asarray(self.output(ds.features, mask=ds.features_mask))
             ev.eval(ds.labels, out, mask=ds.labels_mask)
         return ev
 

@@ -131,3 +131,9 @@ def distribution(dist: dict):
         raise ValueError(f"Unknown distribution type {t}")
 
     return init
+
+
+def resolve(spec):
+    """Resolve a weight-init spec — a name ("xavier", ...) or a distribution
+    dict ({"type": "normal", ...}) — to an init fn."""
+    return distribution(spec) if isinstance(spec, dict) else get(spec)

@@ -84,6 +84,7 @@ def gradient_check_fn(loss_fn, params, *, epsilon: float = 1e-6,
 
 def check_network_gradients(net, ds, *, epsilon: float = 1e-6,
                             max_rel_error: float = 1e-5,
+                            min_abs_error: float = 1e-9,
                             sample_per_leaf: int | None = 128,
                             seed: int = 0) -> GradCheckResult:
     """GradientCheckUtil.checkGradients equivalent for a MultiLayerNetwork
@@ -101,4 +102,5 @@ def check_network_gradients(net, ds, *, epsilon: float = 1e-6,
 
     return gradient_check_fn(
         loss_fn, net.params, epsilon=epsilon, max_rel_error=max_rel_error,
-        sample_per_leaf=sample_per_leaf, seed=seed)
+        min_abs_error=min_abs_error, sample_per_leaf=sample_per_leaf,
+        seed=seed)

@@ -1,0 +1,213 @@
+"""CNN stack tests: ConvolutionMode shape semantics, gradient checks per
+layer type (CNNGradientCheckTest.java / BNGradientCheckTest.java /
+LRNGradientCheckTests.java / GlobalPoolingGradientCheckTests.java analogue),
+and a LeNet end-to-end smoke run (MultiLayerTest-style convergence)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.conf.layers_conv import (
+    BatchNorm,
+    Convolution1D,
+    Convolution2D,
+    GlobalPooling,
+    LocalResponseNormalization,
+    Subsampling,
+    Subsampling1D,
+    ZeroPadding,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.ops.convolution import out_size
+from deeplearning4j_tpu.utils.gradient_check import check_network_gradients
+
+F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+
+
+def cnn_ds(n=4, h=8, w=8, c=2, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, h, w, c))
+    y = np.eye(classes)[rng.integers(0, classes, n)]
+    return DataSet(x, y)
+
+
+def cnn_net(*mid_layers, h=8, w=8, c=2, classes=3, seed=42):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Sgd(0.1)).dtype(F64).list())
+    for l in mid_layers:
+        b.layer(l)
+    b.layer(Output(n_out=classes, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.convolutional(h, w, c))
+    return MultiLayerNetwork(b.build()).init()
+
+
+# ---------------------------------------------------------------- shape math
+def test_out_size_modes():
+    # truncate floors partial windows
+    assert out_size(10, 3, 2, 0, "truncate") == 4
+    # same: ceil(in/stride)
+    assert out_size(10, 3, 2, 0, "same") == 5
+    assert out_size(28, 5, 1, 0, "same") == 28
+    # strict raises on non-exact fit
+    with pytest.raises(ValueError):
+        out_size(10, 3, 2, 0, "strict")
+    assert out_size(9, 3, 2, 0, "strict") == 4
+    # dilation enlarges the effective kernel
+    assert out_size(10, 3, 1, 0, "truncate", dilation=2) == 6
+
+
+def test_conv_output_shapes():
+    net = cnn_net(
+        Convolution2D(n_out=4, kernel=(3, 3), stride=(1, 1), activation="relu"),
+        Subsampling(kernel=(2, 2), stride=(2, 2)),
+    )
+    ds = cnn_ds()
+    acts = net.feed_forward(ds.features)
+    assert acts[0].shape == (4, 6, 6, 4)   # 8-3+1 = 6
+    assert acts[1].shape == (4, 3, 3, 4)   # pooled /2
+    assert acts[-1].shape == (4, 3)
+
+
+def test_same_mode_preserves_hw():
+    net = cnn_net(Convolution2D(n_out=4, kernel=(3, 3), mode="same",
+                                activation="relu"))
+    acts = net.feed_forward(cnn_ds().features)
+    assert acts[0].shape == (4, 8, 8, 4)
+
+
+def test_zero_padding_shape():
+    net = cnn_net(ZeroPadding(pad=(1, 2, 3, 4)),
+                  Convolution2D(n_out=2, kernel=(3, 3), activation="relu"))
+    acts = net.feed_forward(cnn_ds().features)
+    assert acts[0].shape == (4, 8 + 3, 8 + 7, 2)
+
+
+# ------------------------------------------------------------ gradient checks
+def test_conv2d_gradients():
+    net = cnn_net(Convolution2D(n_out=3, kernel=(3, 3), activation="tanh"))
+    res = check_network_gradients(net, cnn_ds(), sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+def test_conv2d_same_strided_gradients():
+    net = cnn_net(Convolution2D(n_out=3, kernel=(3, 3), stride=(2, 2),
+                                mode="same", activation="tanh"))
+    res = check_network_gradients(net, cnn_ds(), sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+@pytest.mark.parametrize("pooling", ["max", "avg", "pnorm"])
+def test_subsampling_gradients(pooling):
+    net = cnn_net(
+        Convolution2D(n_out=3, kernel=(3, 3), activation="tanh"),
+        Subsampling(kernel=(2, 2), stride=(2, 2), pooling=pooling),
+    )
+    res = check_network_gradients(net, cnn_ds(), sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+def test_batchnorm_dense_gradients():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.1)).dtype(F64).list()
+            .layer(Dense(n_in=5, n_out=6, activation="tanh"))
+            .layer(BatchNorm())
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 5)), np.eye(3)[rng.integers(0, 3, 8)])
+    res = check_network_gradients(net, ds, sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+def test_batchnorm_cnn_gradients():
+    net = cnn_net(
+        Convolution2D(n_out=3, kernel=(3, 3), activation="identity"),
+        BatchNorm(activation="relu"),
+    )
+    res = check_network_gradients(net, cnn_ds(), sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+def test_lrn_gradients():
+    net = cnn_net(
+        Convolution2D(n_out=4, kernel=(3, 3), activation="tanh"),
+        LocalResponseNormalization(),
+    )
+    res = check_network_gradients(net, cnn_ds(), sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+@pytest.mark.parametrize("pooling", ["max", "avg", "sum", "pnorm"])
+def test_global_pooling_cnn_gradients(pooling):
+    net = cnn_net(
+        Convolution2D(n_out=3, kernel=(3, 3), activation="tanh"),
+        GlobalPooling(pooling=pooling),
+    )
+    res = check_network_gradients(net, cnn_ds(), sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+def test_conv1d_gradients():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.1)).dtype(F64).list()
+            .layer(Convolution1D(n_out=4, kernel=3, activation="tanh"))
+            .layer(Subsampling1D(kernel=2, stride=2, pooling="max"))
+            .layer(GlobalPooling(pooling="avg"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(5, 10))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(4, 10, 5)), np.eye(3)[rng.integers(0, 3, 4)])
+    res = check_network_gradients(net, ds, sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+
+# ---------------------------------------------------------------- state + e2e
+def test_batchnorm_running_stats_update():
+    net = cnn_net(Convolution2D(n_out=3, kernel=(3, 3), activation="identity"),
+                  BatchNorm(decay=0.5))
+    bn_name = net.layers[1].name
+    before = np.asarray(net.state[bn_name]["mean"]).copy()
+    ds = cnn_ds()
+    net.fit_batch(ds)
+    after = np.asarray(net.state[bn_name]["mean"])
+    assert not np.allclose(before, after)
+    # inference uses running stats: two eval calls agree (no batch dependence)
+    o1 = np.asarray(net.output(ds.features[:2]))
+    o2 = np.asarray(net.output(ds.features[:2]))
+    np.testing.assert_allclose(o1, o2)
+
+
+def test_lenet_learns_synthetic_mnist():
+    """LeNet-style net reaches high train accuracy on a separable synthetic
+    image problem (the MultiLayerTest MNIST smoke-test analogue)."""
+    rng = np.random.default_rng(0)
+    n, classes = 256, 4
+    templates = rng.normal(0, 1.5, size=(classes, 12, 12, 1))
+    idx = rng.integers(0, classes, n)
+    x = templates[idx] + rng.normal(0, 0.4, size=(n, 12, 12, 1))
+    y = np.eye(classes)[idx]
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-2)).list()
+            .layer(Convolution2D(n_out=8, kernel=(3, 3), activation="relu"))
+            .layer(Subsampling(kernel=(2, 2), stride=(2, 2)))
+            .layer(Convolution2D(n_out=16, kernel=(3, 3), activation="relu"))
+            .layer(Subsampling(kernel=(2, 2), stride=(2, 2)))
+            .layer(Dense(n_out=32, activation="relu"))
+            .layer(Output(n_out=classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    it = ArrayDataSetIterator(x, y, batch_size=64)
+    net.fit(it, epochs=6, async_prefetch=False)
+    acc = net.evaluate(DataSet(x, y)).accuracy()
+    assert acc > 0.9, f"LeNet failed to learn: acc={acc}"

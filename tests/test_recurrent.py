@@ -1,0 +1,230 @@
+"""Recurrent stack tests: LSTM/BiLSTM gradient checks (GradientCheckTests +
+GradientCheckTestsMasking analogue), masking semantics, rnn_time_step
+streaming-vs-full-sequence equivalence, tBPTT, and a char-RNN-style
+convergence smoke test."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.conf.layers_conv import GlobalPooling
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LastTimeStep,
+    RnnOutput,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.utils.gradient_check import check_network_gradients
+
+F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+
+
+def seq_ds(n=4, t=6, f=3, classes=3, seed=0, per_step_labels=True,
+           with_mask=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, t, f))
+    if per_step_labels:
+        y = np.eye(classes)[rng.integers(0, classes, (n, t))]
+    else:
+        y = np.eye(classes)[rng.integers(0, classes, n)]
+    fmask = lmask = None
+    if with_mask:
+        lengths = rng.integers(2, t + 1, n)
+        fmask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float64)
+        lmask = fmask if per_step_labels else None
+    return DataSet(x, y, fmask, lmask)
+
+
+def rnn_net(*layers, f=3, t=6, seed=42, tbptt=None):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Sgd(0.1)).dtype(F64).list())
+    for l in layers:
+        b.layer(l)
+    b.set_input_type(InputType.recurrent(f, t))
+    if tbptt:
+        b.backprop_type("tbptt", tbptt, tbptt)
+    return MultiLayerNetwork(b.build()).init()
+
+
+# ------------------------------------------------------------ gradient checks
+def test_lstm_rnn_output_gradients():
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    res = check_network_gradients(net, seq_ds(), sample_per_leaf=30)
+    assert res.passed, res.failures[:5]
+
+
+def test_bidirectional_lstm_gradients():
+    net = rnn_net(GravesBidirectionalLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    res = check_network_gradients(net, seq_ds(), sample_per_leaf=25)
+    assert res.passed, res.failures[:5]
+
+
+def test_stacked_lstm_gradients():
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    res = check_network_gradients(net, seq_ds(), sample_per_leaf=20)
+    assert res.passed, res.failures[:5]
+
+
+def test_lstm_masked_gradients():
+    """GradientCheckTestsMasking analogue: per-timestep masks on features
+    and labels."""
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    res = check_network_gradients(net, seq_ds(with_mask=True),
+                                  sample_per_leaf=30)
+    assert res.passed, res.failures[:5]
+
+
+def test_lstm_global_pooling_classification_gradients():
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  GlobalPooling(pooling="avg"),
+                  Output(n_out=3, activation="softmax", loss="mcxent"))
+    res = check_network_gradients(net, seq_ds(per_step_labels=False),
+                                  sample_per_leaf=30)
+    assert res.passed, res.failures[:5]
+
+
+def test_lstm_last_time_step_gradients():
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  LastTimeStep(),
+                  Output(n_out=3, activation="softmax", loss="mcxent"))
+    res = check_network_gradients(net, seq_ds(per_step_labels=False),
+                                  sample_per_leaf=30)
+    assert res.passed, res.failures[:5]
+
+
+# ------------------------------------------------------------------- masking
+def test_masked_timesteps_do_not_affect_loss():
+    """Changing features at masked timesteps must not change the loss."""
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    ds = seq_ds(with_mask=True, seed=3)
+    base = net.score(ds)
+    x2 = np.array(ds.features)
+    x2[ds.features_mask == 0] = 99.0
+    ds2 = DataSet(x2, ds.labels, ds.features_mask, ds.labels_mask)
+    assert abs(net.score(ds2) - base) < 1e-9
+
+
+def test_last_time_step_respects_mask():
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  LastTimeStep(),
+                  Output(n_out=3, activation="softmax", loss="mcxent"))
+    ds = seq_ds(with_mask=True, per_step_labels=False, seed=5)
+    # garbage beyond each sequence's length must not change the output
+    fn_out = np.asarray(net.output(ds.features, mask=ds.features_mask))
+    x2 = np.array(ds.features)
+    x2[ds.features_mask == 0] = -50.0
+    fn_out2 = np.asarray(net.output(x2, mask=ds.features_mask))
+    np.testing.assert_allclose(fn_out, fn_out2, atol=1e-12)
+
+
+def test_mask_downsampled_through_time_shrinking_layers():
+    """A stride-2 1D pool halves the time axis; the features mask must be
+    downsampled in lockstep before reaching downstream mask-aware layers
+    (feedForwardMaskArray parity)."""
+    from deeplearning4j_tpu.nn.conf.layers_conv import Subsampling1D
+
+    net = rnn_net(Subsampling1D(kernel=2, stride=2, pooling="max"),
+                  GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"),
+                  t=8)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8, 3))
+    fmask = np.ones((4, 8))
+    fmask[0, 4:] = 0  # first example: length 4 -> pooled length 2
+    out = np.asarray(net.output(x, mask=fmask))
+    assert out.shape == (4, 4, 3)
+    # masked tail must not affect the masked example's valid prefix
+    x2 = x.copy()
+    x2[0, 4:] = 77.0
+    out2 = np.asarray(net.output(x2, mask=fmask))
+    np.testing.assert_allclose(out[0, :2], out2[0, :2], atol=1e-12)
+
+
+# ------------------------------------------------------- streaming / tBPTT
+def test_rnn_time_step_matches_full_sequence():
+    """Feeding a sequence step-by-step through rnn_time_step must equal the
+    full-sequence forward (BaseRecurrentLayer stateMap parity)."""
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    ds = seq_ds(seed=7)
+    full = np.asarray(net.output(ds.features))
+    net.rnn_clear_previous_state()
+    steps = []
+    for t in range(ds.features.shape[1]):
+        steps.append(np.asarray(net.rnn_time_step(ds.features[:, t, :])))
+    stepped = np.stack(steps, axis=1)
+    np.testing.assert_allclose(full, stepped, rtol=1e-8, atol=1e-10)
+    # clearing state restarts the stream
+    net.rnn_clear_previous_state()
+    first = np.asarray(net.rnn_time_step(ds.features[:, 0, :]))
+    np.testing.assert_allclose(first, full[:, 0, :], rtol=1e-8, atol=1e-10)
+
+
+def test_rnn_time_step_chunked():
+    net = rnn_net(GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+    ds = seq_ds(t=8, seed=9)
+    full = np.asarray(net.output(ds.features))
+    net.rnn_clear_previous_state()
+    a = np.asarray(net.rnn_time_step(ds.features[:, :3, :]))
+    b = np.asarray(net.rnn_time_step(ds.features[:, 3:, :]))
+    np.testing.assert_allclose(full, np.concatenate([a, b], axis=1),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_tbptt_training_runs_and_learns():
+    """tBPTT chunks the sequence and carries LSTM state across chunks."""
+    rng = np.random.default_rng(0)
+    n, t, f, classes = 32, 12, 4, 2
+    # class depends on the sign of the mean of the FIRST chunk -> state must
+    # carry for the model to use it at the end
+    x = rng.normal(size=(n, t, f))
+    y_idx = (x[:, :4, :].mean(axis=(1, 2)) > 0).astype(int)
+    y = np.eye(classes)[np.repeat(y_idx[:, None], t, axis=1)]
+    net = rnn_net(GravesLSTM(n_out=8, activation="tanh"),
+                  RnnOutput(n_out=classes, activation="softmax", loss="mcxent"),
+                  f=f, t=t, tbptt=4)
+    it = ArrayDataSetIterator(x, y, batch_size=16)
+    for _ in range(40):
+        for ds in it:
+            net.fit_batch(ds)
+        it.reset()
+    # state carries are stripped after each batch
+    for sub in net.state.values():
+        assert "h" not in sub and "c" not in sub
+    assert float(net.score(DataSet(x, y))) < 0.55
+
+
+def test_char_rnn_style_convergence():
+    """GravesLSTM char-RNN capability bar (BASELINE.md config #3): learn a
+    deterministic cyclic sequence to low loss."""
+    period, vocab, t, n = 5, 6, 10, 64
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, period, n)
+    seq = (starts[:, None] + np.arange(t + 1)[None, :]) % period
+    x = np.eye(vocab)[seq[:, :-1]]
+    y = np.eye(vocab)[seq[:, 1:]]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12).updater(Adam(1e-2)).list()
+            .layer(GravesLSTM(n_out=16, activation="tanh"))
+            .layer(RnnOutput(n_out=vocab, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab, t))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    it = ArrayDataSetIterator(x, y, batch_size=32)
+    net.fit(it, epochs=60, async_prefetch=False)
+    preds = np.asarray(net.output(x))
+    acc = (preds.argmax(-1) == seq[:, 1:]).mean()
+    assert acc > 0.95, f"char-RNN failed to learn cyclic sequence: acc={acc}"
