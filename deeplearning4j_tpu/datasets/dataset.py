@@ -61,3 +61,32 @@ class DataSet:
             cat([d.features_mask for d in datasets]),
             cat([d.labels_mask for d in datasets]),
         )
+
+
+@dataclass
+class MultiDataSet:
+    """Multi-input/multi-output minibatch (org.nd4j MultiDataSet parity, as
+    consumed by ComputationGraph — nn/graph/ComputationGraph.java fit paths).
+    All fields are tuples/lists of arrays (or None masks)."""
+
+    features: list
+    labels: list
+    features_masks: Optional[list] = None
+    labels_masks: Optional[list] = None
+
+    def __post_init__(self):
+        self.features = list(self.features)
+        self.labels = list(self.labels)
+        if self.features_masks is None:
+            self.features_masks = [None] * len(self.features)
+        if self.labels_masks is None:
+            self.labels_masks = [None] * len(self.labels)
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    @staticmethod
+    def from_dataset(ds: DataSet) -> "MultiDataSet":
+        return MultiDataSet([ds.features], [ds.labels],
+                            [ds.features_mask], [ds.labels_mask])

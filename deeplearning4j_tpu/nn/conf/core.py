@@ -121,6 +121,11 @@ class NeuralNetConfBuilder:
     def list(self) -> "ListBuilder":
         return ListBuilder(self.build())
 
+    def graph_builder(self):
+        """DAG builder (reference: .graphBuilder())."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+        return GraphBuilder(self.build())
+
 
 class ListBuilder:
     """Builds a MultiLayerConfiguration (reference:

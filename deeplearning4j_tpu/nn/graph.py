@@ -1,0 +1,406 @@
+"""ComputationGraph — DAG network with multi-input/multi-output training.
+
+Parity: nn/graph/ComputationGraph.java (2,447 LoC): init() :273,
+topologicalSortOrder() :888 (here on the config), feedForward :1089 (walk
+topo order), calcBackpropGradients :1224 (here JAX autodiff through the DAG
+— fan-in epsilon accumulation falls out of reverse-mode AD), fit :701.
+
+Like MultiLayerNetwork, ``fit`` compiles ONE jitted train step (forward over
+the whole DAG + loss sum over output layers + backward + updaters fused into
+a single XLA program). Multi-output losses are summed (the reference
+accumulates output-layer scores the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.layers import BaseLayerConfig
+from deeplearning4j_tpu.nn.updater import apply_layer_updates
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.topo = conf.topological_order()
+        self.layers = None          # runtime layer objects (layer vertices)
+        self.vertex_kind = None     # name -> "layer" | "vertex"
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value = None
+        self._train_step = None
+        self._apply_fns = {}
+        self._mesh = None
+        self._rng_key = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
+        gc = self.conf.global_conf
+        seed = gc.seed if seed is None else seed
+        self._rng_key = jax.random.PRNGKey(seed)
+
+        # resolve InputTypes through the DAG
+        input_types: Dict[str, object] = {}
+        if self.conf.input_types is not None:
+            for name, it in zip(self.conf.network_inputs, self.conf.input_types):
+                input_types[name] = it
+
+        self.layers = []
+        self._layer_by_name = {}
+        self.vertex_kind = {}
+        self._resolved_confs = {}
+        for name in self.topo:
+            conf = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            in_types = [input_types.get(i) for i in in_names]
+            if isinstance(conf, BaseLayerConfig):
+                self.vertex_kind[name] = "layer"
+                if len(in_names) != 1:
+                    raise ValueError(
+                        f"Layer vertex '{name}' must have exactly 1 input, "
+                        f"got {in_names} (merge first — MergeVertex)")
+                it = in_types[0]
+                if it is not None:
+                    conf = conf.with_n_in(it)
+                if getattr(conf, "n_in", 1) is None:
+                    raise ValueError(
+                        f"Layer vertex '{name}': n_in not set and no "
+                        f"input type available for inference")
+                layer = conf.make_layer(it, gc, gc.dtype)
+                self.layers.append(layer)
+                self._layer_by_name[name] = layer
+                self._resolved_confs[name] = conf
+                input_types[name] = layer.output_type
+            else:
+                self.vertex_kind[name] = "vertex"
+                self._resolved_confs[name] = conf
+                if all(t is not None for t in in_types):
+                    input_types[name] = conf.output_type(*in_types)
+                else:
+                    input_types[name] = None
+
+        def init_trees(key):
+            params, state = {}, {}
+            for layer in self.layers:
+                key_, sub = jax.random.split(key)
+                key = key_
+                p = layer.init_params(sub)
+                if p:
+                    params[layer.name] = p
+                s = layer.init_state()
+                if s:
+                    state[layer.name] = s
+            opt_state = {}
+            for layer in self.layers:
+                if layer.name in params:
+                    upd = layer.resolve("updater")
+                    opt_state[layer.name] = upd.init_state(params[layer.name])
+            return params, state, opt_state
+
+        if structure_only:
+            self.params, self.state, self.opt_state = jax.eval_shape(
+                init_trees, self._rng_key)
+        else:
+            self.params, self.state, self.opt_state = init_trees(self._rng_key)
+        self.iteration = 0
+        self._train_step = None
+        self._apply_fns = {}
+        return self
+
+    def materialize_state(self):
+        state = {}
+        for layer in self.layers:
+            s = layer.init_state()
+            if s:
+                state[layer.name] = s
+        self.state = state
+
+    def materialize_opt_state(self):
+        opt_state = {}
+        for layer in self.layers:
+            if layer.name in self.params:
+                upd = layer.resolve("updater")
+                opt_state[layer.name] = upd.init_state(self.params[layer.name])
+        self.opt_state = opt_state
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def use_mesh(self, mesh, data_axis: str = "data"):
+        """Data-parallel sharding over a Mesh (see parallel/)."""
+        from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
+        self._mesh = (mesh, data_axis)
+        self._train_step = None
+        self._apply_fns = {}
+        apply_mesh(self, mesh, data_axis)
+        return self
+
+    def _require_init(self):
+        if self.params is None:
+            raise RuntimeError("Call init() before fit()/output()/evaluate()")
+
+    # -------------------------------------------------------------- forward
+    def _walk(self, params, state, inputs: Dict, *, train, rng,
+              fmasks: Optional[Dict] = None, need_inputs_of=()):
+        """Walk the DAG in topo order. Returns (activations dict, per-vertex
+        input activations for ``need_inputs_of``, masks dict, new_state)."""
+        acts = dict(inputs)
+        masks = dict(fmasks or {})
+        saved_inputs = {}
+        new_state = dict(state)
+        from deeplearning4j_tpu.nn.conf.vertices import (
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex)
+        for name in self.topo:
+            conf = self._resolved_confs[name]
+            in_names = self.conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
+            in_masks = [masks.get(i) for i in in_names]
+            # named-input wiring for the rnn vertices (reference API:
+            # LastTimeStepVertex(maskArrayInput), DuplicateToTimeSeriesVertex
+            # (inputName)) — the named vertex supplies the mask / time length
+            if isinstance(conf, LastTimeStepVertex) and conf.mask_input:
+                in_masks = [masks.get(conf.mask_input)]
+            if (isinstance(conf, DuplicateToTimeSeriesVertex)
+                    and conf.seq_input):
+                xs = [xs[0], acts[conf.seq_input]]
+                in_masks = [in_masks[0], masks.get(conf.seq_input)]
+            if name in need_inputs_of:
+                saved_inputs[name] = (xs, in_masks)
+            if self.vertex_kind[name] == "layer":
+                layer = self._layer_by_name[name]
+                lrng = None
+                if rng is not None:
+                    rng, lrng = jax.random.split(rng)
+                p = params.get(name, {})
+                s = state.get(name, {})
+                y, s_new = layer.apply(p, s, xs[0], train=train, rng=lrng,
+                                       mask=in_masks[0])
+                if s_new:
+                    new_state[name] = s_new
+                acts[name] = y
+                masks[name] = layer.feed_forward_mask(in_masks[0])
+            else:
+                acts[name] = conf.forward(*xs, masks=in_masks)
+                masks[name] = conf.feed_forward_mask(*in_masks)
+        return acts, saved_inputs, masks, new_state
+
+    def _prepare_inputs(self, features: List, fmasks: Optional[List]):
+        inputs = {n: jnp.asarray(f)
+                  for n, f in zip(self.conf.network_inputs, features)}
+        md = {}
+        if fmasks is not None:
+            for n, m in zip(self.conf.network_inputs, fmasks):
+                if m is not None:
+                    md[n] = jnp.asarray(m)
+        return inputs, md
+
+    def _loss(self, params, state, inputs, labels, fmasks, lmasks, rng,
+              train=True):
+        """Sum of output-layer losses + regularization (the scalar the
+        jitted step autodiffs)."""
+        rng_fwd = lrng = None
+        if rng is not None:
+            rng_fwd, lrng = jax.random.split(rng)
+        outs = self.conf.network_outputs
+        acts, saved, masks, new_state = self._walk(
+            params, state, inputs, train=train, rng=rng_fwd, fmasks=fmasks,
+            need_inputs_of=set(outs))
+        total = None
+        for i, name in enumerate(outs):
+            layer = self._layer_by_name.get(name)
+            if layer is None or not hasattr(layer, "loss"):
+                raise ValueError(
+                    f"Network output '{name}' is not a loss-bearing layer "
+                    f"(Output/RnnOutput/LossLayer)")
+            xs, in_masks = saved[name]
+            this_rng = None
+            if lrng is not None:
+                lrng, this_rng = jax.random.split(lrng)
+            lm = None if lmasks is None else lmasks[i]
+            l = layer.loss(params.get(name, {}), xs[0], labels[i],
+                           train=train, rng=this_rng, mask=lm)
+            total = l if total is None else total + l
+        for layer in self.layers:
+            if layer.name in params:
+                total = total + layer.regularization(params[layer.name])
+        return total, new_state
+
+    # ---------------------------------------------------------- train step
+    def _build_train_step(self):
+        gc = self.conf.global_conf
+        layers = self.layers
+
+        def loss_fn(params, state, inputs, labels, fmasks, lmasks, rng):
+            return self._loss(params, state, inputs, labels, fmasks, lmasks,
+                              rng)
+
+        def step_fn(params, state, opt_state, it, inputs, labels, fmasks,
+                    lmasks, rng):
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, inputs, labels, fmasks,
+                                       lmasks, rng)
+            new_params, new_opt = apply_layer_updates(
+                layers, gc, params, grads, opt_state, it)
+            return new_params, new_state, new_opt, score
+
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.data_parallel import (
+                shard_step_multi)
+            return shard_step_multi(self, step_fn, *self._mesh)
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    @staticmethod
+    def _coerce(data) -> MultiDataSet:
+        if isinstance(data, MultiDataSet):
+            return data
+        if isinstance(data, DataSet):
+            return MultiDataSet.from_dataset(data)
+        raise TypeError(f"Expected DataSet or MultiDataSet, got {type(data)}")
+
+    def fit_batch(self, mds):
+        """One optimization step on one (Multi)DataSet minibatch
+        (ComputationGraph.fit parity)."""
+        self._require_init()
+        if self.conf.backprop_type == "tbptt":
+            raise NotImplementedError(
+                "Truncated BPTT is not yet implemented for ComputationGraph "
+                "(supported on MultiLayerNetwork); use backprop_type="
+                "'standard' or a sequential net")
+        mds = self._coerce(mds)
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        self._rng_key, rng = jax.random.split(self._rng_key)
+        inputs, fmasks = self._prepare_inputs(mds.features, mds.features_masks)
+        labels = [jnp.asarray(l) for l in mds.labels]
+        lmasks = [None if m is None else jnp.asarray(m)
+                  for m in mds.labels_masks]
+        if all(m is None for m in lmasks):
+            lmasks = None
+        it = jnp.asarray(self.iteration, jnp.int32)
+        self.params, self.state, self.opt_state, score = self._train_step(
+            self.params, self.state, self.opt_state, it, inputs, labels,
+            fmasks, lmasks, rng)
+        self.iteration += 1
+        self.score_value = score
+        self.last_batch_examples = mds.num_examples
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+        return score
+
+    def fit(self, data, *, epochs: int = 1):
+        """Train on an iterator of DataSet/MultiDataSet, or a single one."""
+        if isinstance(data, (DataSet, MultiDataSet)):
+            items = [data]
+            for _ in range(epochs):
+                for d in items:
+                    self.fit_batch(d)
+                self.epoch += 1
+            return self
+        for _ in range(epochs):
+            for d in data:
+                self.fit_batch(d)
+            data.reset()
+            for l in self.listeners:
+                l.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    # ------------------------------------------------------------ inference
+    def output(self, *features, masks=None, train: bool = False):
+        """Forward pass -> tuple of network-output activations (single array
+        if the graph has one output)."""
+        self._require_init()
+        feats = [jnp.asarray(f) for f in features]
+        key = ("out", train, masks is not None)
+        if key not in self._apply_fns:
+            def fn(params, state, inputs, fmasks):
+                acts, _, _, _ = self._walk(params, state, inputs, train=train,
+                                           rng=None, fmasks=fmasks)
+                return tuple(acts[o] for o in self.conf.network_outputs)
+            self._apply_fns[key] = jax.jit(fn)
+        inputs, fmasks = self._prepare_inputs(
+            feats, masks if masks is not None else None)
+        outs = self._apply_fns[key](self.params, self.state, inputs, fmasks)
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *features, masks=None, train: bool = False):
+        """All vertex activations as a dict (feedForward :1089 parity)."""
+        self._require_init()
+        feats = [jnp.asarray(f) for f in features]
+        inputs, fmasks = self._prepare_inputs(feats, masks)
+        acts, _, _, _ = self._walk(self.params, self.state, inputs,
+                                   train=train, rng=None, fmasks=fmasks)
+        return acts
+
+    def score(self, mds, train: bool = False):
+        self._require_init()
+        mds = self._coerce(mds)
+        inputs, fmasks = self._prepare_inputs(mds.features, mds.features_masks)
+        labels = [jnp.asarray(l) for l in mds.labels]
+        lmasks = [None if m is None else jnp.asarray(m)
+                  for m in mds.labels_masks]
+        if all(m is None for m in lmasks):
+            lmasks = None
+        loss, _ = self._loss(self.params, self.state, inputs, labels, fmasks,
+                             lmasks, rng=None, train=train)
+        return float(loss)
+
+    def evaluate(self, iterator):
+        """Classification eval for single-output graphs (evaluate parity)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        if len(self.conf.network_outputs) != 1:
+            raise ValueError("evaluate() requires a single-output graph")
+        ev = Evaluation()
+        if isinstance(iterator, (DataSet, MultiDataSet)):
+            iterator = [iterator]
+        for d in iterator:
+            mds = self._coerce(d)
+            out = self.output(*mds.features, masks=(
+                mds.features_masks
+                if any(m is not None for m in mds.features_masks) else None))
+            ev.eval(mds.labels[0], np.asarray(out), mask=mds.labels_masks[0])
+        return ev
+
+    # ---------------------------------------------------------------- misc
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    def summary(self) -> str:
+        lines = ["=" * 78]
+        lines.append(f"{'name':<20}{'kind':<16}{'inputs':<28}{'params':>10}")
+        lines.append("-" * 78)
+        for name in self.topo:
+            kind = self.vertex_kind[name]
+            t = (self._resolved_confs[name].layer_type if kind == "layer"
+                 else self._resolved_confs[name].vertex_type)
+            p = self.params.get(name, {})
+            n = sum(int(np.prod(a.shape)) for a in jax.tree_util.tree_leaves(p))
+            ins = ",".join(self.conf.vertex_inputs[name])
+            lines.append(f"{name:<20}{t:<16}{ins:<28}{n:>10}")
+        lines.append("-" * 78)
+        lines.append(f"total params: {self.num_params()}")
+        lines.append("=" * 78)
+        return "\n".join(lines)
+
+    def clone(self):
+        net = ComputationGraph(self.conf)
+        net.init(structure_only=True)
+        net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+        net.state = jax.tree_util.tree_map(jnp.copy, self.state)
+        net.opt_state = jax.tree_util.tree_map(jnp.copy, self.opt_state)
+        net.iteration = self.iteration
+        net.epoch = self.epoch
+        return net

@@ -82,23 +82,29 @@ class Convolution1DLayerImpl(Layer):
         return self.activation_fn(z.astype(self.param_dtype)), state
 
 
+def _pool2d(x, *, kernel, strides, padding, pooling, pnorm):
+    """Dispatch to the registered pooling op (shared by the 2D and 1D
+    subsampling layers)."""
+    if pooling == "max":
+        return ops.get("max_pool2d")(x, kernel=kernel, strides=strides,
+                                     padding=padding)
+    if pooling == "avg":
+        return ops.get("avg_pool2d")(x, kernel=kernel, strides=strides,
+                                     padding=padding)
+    if pooling == "pnorm":
+        return ops.get("pnorm_pool2d")(x, kernel=kernel, strides=strides,
+                                       padding=padding, p=pnorm)
+    raise ValueError(f"Unknown pooling type: {pooling}")
+
+
 class SubsamplingLayerImpl(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         c = self.conf
         kernel, strides = _pair(c.kernel), _pair(c.stride)
         pads = spatial_padding(
             (x.shape[1], x.shape[2]), kernel, strides, _pair(c.padding), c.mode)
-        if c.pooling == "max":
-            y = ops.get("max_pool2d")(x, kernel=kernel, strides=strides,
-                                      padding=pads)
-        elif c.pooling == "avg":
-            y = ops.get("avg_pool2d")(x, kernel=kernel, strides=strides,
-                                      padding=pads)
-        elif c.pooling == "pnorm":
-            y = ops.get("pnorm_pool2d")(x, kernel=kernel, strides=strides,
-                                        padding=pads, p=c.pnorm)
-        else:
-            raise ValueError(f"Unknown pooling type: {c.pooling}")
+        y = _pool2d(x, kernel=kernel, strides=strides, padding=pads,
+                    pooling=c.pooling, pnorm=c.pnorm)
         return y, state
 
 
@@ -126,21 +132,11 @@ class Subsampling1DLayerImpl(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         c = self.conf
-        x4 = x[:, :, None, :]
-        kernel, strides = (c.kernel, 1), (c.stride, 1)
         pads = spatial_padding((x.shape[1],), (c.kernel,), (c.stride,),
                                (c.padding,), c.mode) + [(0, 0)]
-        if c.pooling == "max":
-            y = ops.get("max_pool2d")(x4, kernel=kernel, strides=strides,
-                                      padding=pads)
-        elif c.pooling == "avg":
-            y = ops.get("avg_pool2d")(x4, kernel=kernel, strides=strides,
-                                      padding=pads)
-        elif c.pooling == "pnorm":
-            y = ops.get("pnorm_pool2d")(x4, kernel=kernel, strides=strides,
-                                        padding=pads, p=c.pnorm)
-        else:
-            raise ValueError(f"Unknown pooling type: {c.pooling}")
+        y = _pool2d(x[:, :, None, :], kernel=(c.kernel, 1),
+                    strides=(c.stride, 1), padding=pads, pooling=c.pooling,
+                    pnorm=c.pnorm)
         return y[:, :, 0, :], state
 
 

@@ -201,8 +201,5 @@ class LastTimeStepLayer(Layer):
         return None
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        if mask is None:
-            return x[:, -1, :], state
-        m = mask.reshape(mask.shape[0], -1)
-        idx = jnp.maximum(jnp.sum(m, axis=1).astype(jnp.int32) - 1, 0)
-        return x[jnp.arange(x.shape[0]), idx, :], state
+        from deeplearning4j_tpu.ops.sequence import last_unmasked_step
+        return last_unmasked_step(x, mask), state

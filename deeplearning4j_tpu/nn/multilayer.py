@@ -36,7 +36,7 @@ from deeplearning4j_tpu.nn.conf.preprocessors import (
     FeedForwardToCnn,
     RnnToFeedForward,
 )
-from deeplearning4j_tpu.nn.updater import normalize_gradients
+from deeplearning4j_tpu.nn.updater import apply_layer_updates
 
 
 def _auto_preprocessor(input_type: InputType, conf):
@@ -235,28 +235,8 @@ class MultiLayerNetwork:
             (score, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, state, x, labels, fmask, lmask,
                                        rng)
-            new_params = dict(params)
-            new_opt = dict(opt_state)
-            for layer in layers:
-                name = layer.name
-                if name not in params:
-                    continue
-                g = grads[name]
-                # preApply: gradient clipping / normalization
-                mode = layer.resolve("gradient_normalization")
-                thr = float(layer.resolve("gradient_normalization_threshold",
-                                          1.0) or 1.0)
-                g = normalize_gradients(g, mode, thr)
-                upd = layer.resolve("updater")
-                base_lr = layer.conf.learning_rate
-                if base_lr is None:
-                    base_lr = gc.learning_rate
-                if base_lr is None:
-                    base_lr = upd.learning_rate
-                lr = gc.lr_schedule(base_lr, it)
-                deltas, new_opt[name] = upd.update(g, opt_state[name], lr)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda p, d: p - d, params[name], deltas)
+            new_params, new_opt = apply_layer_updates(
+                layers, gc, params, grads, opt_state, it)
             return new_params, new_state, new_opt, score
 
         jit_kwargs = {"donate_argnums": (0, 1, 2)}
@@ -323,6 +303,12 @@ class MultiLayerNetwork:
         L = self.conf.tbptt_fwd_length
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)
+        if y.ndim != 3 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "tBPTT requires per-timestep labels [batch, time, out] with "
+                f"the same time length as the features; got labels shape "
+                f"{tuple(y.shape)} vs features {tuple(x.shape)}. For "
+                "sequence-classification labels use backprop_type='standard'")
         fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
         self._set_streaming(True)
@@ -330,19 +316,23 @@ class MultiLayerNetwork:
             if getattr(self, "_tbptt_step", None) is None:
                 self._tbptt_step = self._build_train_step()
             t_total = x.shape[1]
-            score = None
+            score_sum, weight = 0.0, 0
             for start in range(0, t_total, L):
                 sl = slice(start, min(start + L, t_total))
                 self._rng_key, rng = jax.random.split(self._rng_key)
                 it = jnp.asarray(self.iteration, jnp.int32)
-                self.params, self.state, self.opt_state, score = \
+                self.params, self.state, self.opt_state, chunk_score = \
                     self._tbptt_step(
                         self.params, self.state, self.opt_state, it,
                         x[:, sl], y[:, sl],
                         None if fmask is None else fmask[:, sl],
                         None if lmask is None else lmask[:, sl],
                         rng)
+                w = sl.stop - sl.start
+                score_sum = score_sum + float(chunk_score) * w
+                weight += w
             self.state = self._strip_carries(self.state)
+            score = score_sum / max(weight, 1)
         finally:
             self._set_streaming(False)
         self.iteration += 1

@@ -397,3 +397,33 @@ def normalize_gradients(grads, mode: str | None, threshold: float = 1.0):
             return g * s
         return jax.tree_util.tree_map(clip_one, grads)
     raise ValueError(f"Unknown gradient normalization mode: {mode}")
+
+
+def apply_layer_updates(layers, gc, params, grads, opt_state, it):
+    """Apply per-layer gradient normalization + updater to every
+    parameterized layer (LayerUpdater.update :74 / preApply :186 semantics,
+    shared by MultiLayerNetwork and ComputationGraph train steps).
+
+    Returns (new_params, new_opt_state)."""
+    new_params = dict(params)
+    new_opt = dict(opt_state)
+    for layer in layers:
+        name = layer.name
+        if name not in params:
+            continue
+        g = grads[name]
+        mode = layer.resolve("gradient_normalization")
+        thr = float(layer.resolve("gradient_normalization_threshold", 1.0)
+                    or 1.0)
+        g = normalize_gradients(g, mode, thr)
+        upd = layer.resolve("updater")
+        base_lr = layer.conf.learning_rate
+        if base_lr is None:
+            base_lr = gc.learning_rate
+        if base_lr is None:
+            base_lr = upd.learning_rate
+        lr = gc.lr_schedule(base_lr, it)
+        deltas, new_opt[name] = upd.update(g, opt_state[name], lr)
+        new_params[name] = jax.tree_util.tree_map(
+            lambda p, d: p - d, params[name], deltas)
+    return new_params, new_opt

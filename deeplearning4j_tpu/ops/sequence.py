@@ -1,0 +1,16 @@
+"""Sequence/masking helpers shared by recurrent layers and graph vertices."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def last_unmasked_step(x, mask):
+    """[b, t, f] -> [b, f]: the last step, or the last *unmasked* step per
+    example when a [b, t] mask is given (LastTimeStepVertex.java parity;
+    an all-masked row clamps to step 0)."""
+    if mask is None:
+        return x[:, -1, :]
+    m = mask.reshape(mask.shape[0], -1)
+    idx = jnp.maximum(jnp.sum(m, axis=1).astype(jnp.int32) - 1, 0)
+    return x[jnp.arange(x.shape[0]), idx, :]

@@ -89,6 +89,58 @@ def shard_step(net, step_fn, mesh: Mesh, data_axis: str = "data"):
     return wrapped
 
 
+def _mask_lead_shape(label):
+    """Label-mask leading shape: [b] for [b, c] labels, [b, t] for
+    [b, t, c] sequence labels."""
+    return label.shape[:-1] if label.ndim > 1 else label.shape
+
+
+def shard_step_multi(net, step_fn, mesh: Mesh, data_axis: str = "data"):
+    """ComputationGraph variant of shard_step: inputs are a dict and labels/
+    masks are lists; every batch-leading tensor is sharded over the data
+    axis; partial batches are zero-padded with padded rows excluded via the
+    per-output label masks."""
+    repl = NamedSharding(mesh, P())
+    n_shards = mesh.shape[data_axis]
+
+    jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def wrapped(params, state, opt_state, it, inputs, labels, fmasks, lmasks,
+                rng):
+        n = next(iter(inputs.values())).shape[0]
+        target = -(-n // n_shards) * n_shards
+        if target != n:
+            pad = target - n
+
+            def pad0(a):
+                if a is None:
+                    return None
+                widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+                return jnp.pad(jnp.asarray(a), widths)
+
+            inputs = {k: pad0(v) for k, v in inputs.items()}
+            if lmasks is None:
+                lmasks = [jnp.ones(_mask_lead_shape(l), jnp.float32)
+                          for l in labels]
+            else:
+                lmasks = [jnp.ones(_mask_lead_shape(l), jnp.float32)
+                          if m is None else m
+                          for l, m in zip(labels, lmasks)]
+            labels = [pad0(l) for l in labels]
+            lmasks = [pad0(m) for m in lmasks]
+            fmasks = {k: pad0(v) for k, v in fmasks.items()}
+        inputs = {k: shard_batch(mesh, data_axis, v) for k, v in inputs.items()}
+        labels = [shard_batch(mesh, data_axis, l) for l in labels]
+        fmasks = {k: shard_batch(mesh, data_axis, v) for k, v in fmasks.items()}
+        if lmasks is not None:
+            lmasks = [shard_batch(mesh, data_axis, m) for m in lmasks]
+        rng = jax.device_put(rng, repl)
+        return jitted(params, state, opt_state, it, inputs, labels, fmasks,
+                      lmasks, rng)
+
+    return wrapped
+
+
 class ParallelWrapper:
     """Reference-semantics data-parallel trainer: each of N logical workers
     runs ``averaging_frequency`` local steps, then parameters and (optionally)
