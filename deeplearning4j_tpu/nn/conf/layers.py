@@ -29,10 +29,12 @@ def register_layer(cls):
 
 
 def _encode(v):
-    if isinstance(v, Updater):
+    if isinstance(v, BaseLayerConfig):
+        return layer_to_dict(v)
+    if hasattr(v, "to_dict"):  # Updater, ReconstructionDistribution, ...
         return v.to_dict()
     if isinstance(v, tuple):
-        return list(v)
+        return [_encode(x) for x in v]
     return v
 
 
@@ -53,6 +55,8 @@ def layer_from_dict(d: dict) -> "BaseLayerConfig":
     cls = LAYER_REGISTRY[ltype]
     if "updater" in d and isinstance(d["updater"], dict):
         d["updater"] = updater_from_dict(d["updater"])
+    if hasattr(cls, "_decode_fields"):  # nested configs (VAE, Frozen, ...)
+        d = cls._decode_fields(d)
     fields = {f.name for f in dataclasses.fields(cls)}
     # tuple-valued fields arrive as lists from JSON
     for k, v in list(d.items()):

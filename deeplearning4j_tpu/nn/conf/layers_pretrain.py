@@ -1,0 +1,255 @@
+"""Pretrain-family + special-output layer configs: VAE, AutoEncoder, RBM,
+CenterLossOutput, Frozen.
+
+Parity: nn/conf/layers/{variational/VariationalAutoencoder, AutoEncoder,
+RBM, CenterLossOutputLayer}.java and nn/layers/FrozenLayer.java
+(SURVEY.md §2.1/2.2). Reconstruction distributions mirror
+nn/conf/layers/variational/{BernoulliReconstructionDistribution,
+GaussianReconstructionDistribution, ExponentialReconstructionDistribution,
+CompositeReconstructionDistribution, LossFunctionWrapper}.java.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayerConfig,
+    FeedForwardLayerConfig,
+    LAYER_REGISTRY,
+    layer_from_dict,
+    layer_to_dict,
+    register_layer,
+)
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction distributions (pure specs; math lives in layers/variational)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ReconstructionDistribution:
+    kind = "base"
+
+    def to_dict(self):
+        import dataclasses as dc
+        d = {}
+        for f in dc.fields(self):
+            v = getattr(self, f.name)
+            if f.name == "distributions":
+                v = [[n, inner.to_dict()] for n, inner in v]
+            d[f.name] = v
+        d["kind"] = self.kind
+        return d
+
+    def param_size(self, data_size: int) -> int:
+        raise NotImplementedError
+
+
+_DISTRIBUTIONS: dict[str, type] = {}
+
+
+def register_distribution(cls):
+    _DISTRIBUTIONS[cls.kind] = cls
+    return cls
+
+
+def distribution_from_dict(d: dict) -> ReconstructionDistribution:
+    d = dict(d)
+    kind = d.pop("kind")
+    if kind == "composite":
+        d["distributions"] = tuple(
+            (n, distribution_from_dict(inner))
+            for n, inner in d.get("distributions", ()))
+    cls = _DISTRIBUTIONS[kind]
+    import dataclasses as dc
+    names = {f.name for f in dc.fields(cls)}
+    for k, v in list(d.items()):
+        if isinstance(v, list) and k in names and k != "distributions":
+            d[k] = tuple(v)
+    return cls(**{k: v for k, v in d.items() if k in names})
+
+
+@register_distribution
+@dataclass(frozen=True)
+class BernoulliReconstruction(ReconstructionDistribution):
+    """p(x|z) Bernoulli with sigmoid'd logits
+    (BernoulliReconstructionDistribution.java)."""
+
+    kind = "bernoulli"
+
+    def param_size(self, data_size: int) -> int:
+        return data_size
+
+
+@register_distribution
+@dataclass(frozen=True)
+class GaussianReconstruction(ReconstructionDistribution):
+    """p(x|z) diagonal Gaussian: head emits [mean, log var]
+    (GaussianReconstructionDistribution.java)."""
+
+    kind = "gaussian"
+    activation: str = "identity"
+
+    def param_size(self, data_size: int) -> int:
+        return 2 * data_size
+
+
+@register_distribution
+@dataclass(frozen=True)
+class ExponentialReconstruction(ReconstructionDistribution):
+    """p(x|z) exponential, head emits gamma = log(lambda)
+    (ExponentialReconstructionDistribution.java)."""
+
+    kind = "exponential"
+
+    def param_size(self, data_size: int) -> int:
+        return data_size
+
+
+@register_distribution
+@dataclass(frozen=True)
+class LossWrapperReconstruction(ReconstructionDistribution):
+    """-log p(x|z) := a standard loss (LossFunctionWrapper.java)."""
+
+    kind = "loss_wrapper"
+    loss: str = "mse"
+    activation: str = "identity"
+
+    def param_size(self, data_size: int) -> int:
+        return data_size
+
+
+@register_distribution
+@dataclass(frozen=True)
+class CompositeReconstruction(ReconstructionDistribution):
+    """Different distributions over feature ranges
+    (CompositeReconstructionDistribution.java): tuple of
+    (num_features, distribution)."""
+
+    kind = "composite"
+    distributions: Tuple = ()
+
+    def param_size(self, data_size: int) -> int:
+        assert sum(n for n, _ in self.distributions) == data_size, (
+            "Composite distribution sizes must sum to the data size")
+        return sum(d.param_size(n) for n, d in self.distributions)
+
+
+# ---------------------------------------------------------------------------
+# Layer configs
+# ---------------------------------------------------------------------------
+
+@register_layer
+@dataclass(frozen=True)
+class VariationalAutoencoder(FeedForwardLayerConfig):
+    """VAE as ONE layer: encoder/decoder MLPs + reparameterization + ELBO
+    (nn/layers/variational/VariationalAutoencoder.java, 1,095 LoC parity).
+    n_out = latent size. Supervised ``activate`` emits the posterior mean
+    (matching the reference). Pretrains on unlabeled features via
+    MultiLayerNetwork.pretrain()."""
+
+    layer_type = "vae"
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction: ReconstructionDistribution = field(
+        default_factory=BernoulliReconstruction)
+    num_samples: int = 1
+
+    @classmethod
+    def _decode_fields(cls, d):
+        if isinstance(d.get("reconstruction"), dict):
+            d["reconstruction"] = distribution_from_dict(d["reconstruction"])
+        return d
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.variational import VAELayer
+        return VAELayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class AutoEncoder(FeedForwardLayerConfig):
+    """Denoising autoencoder (nn/layers/feedforward/autoencoder/
+    AutoEncoder.java parity): corruption_level masks inputs during pretrain;
+    supervised activate = encoder forward."""
+
+    layer_type = "autoencoder"
+    corruption_level: float = 0.3
+    loss: str = "mse"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.pretrain import AutoEncoderLayer
+        return AutoEncoderLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class RBM(FeedForwardLayerConfig):
+    """Restricted Boltzmann machine (nn/layers/feedforward/rbm/RBM.java
+    parity, legacy): CD-k pretraining, sigmoid propup as activate."""
+
+    layer_type = "rbm"
+    k: int = 1  # contrastive divergence steps
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.pretrain import RBMLayer
+        return RBMLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class CenterLossOutput(FeedForwardLayerConfig):
+    """Softmax classification + center loss
+    (nn/layers/training/CenterLossOutputLayer.java parity):
+    loss = dataLoss + lambda/2 * ||f - c_y||^2; class centers live in layer
+    state and track features with an ``alpha`` moving average."""
+
+    layer_type = "center_loss_output"
+    loss: str = "mcxent"
+    alpha: float = 0.05
+    lmbda: float = 2e-4
+    has_bias: bool = True
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.pretrain import CenterLossOutputLayer
+        return CenterLossOutputLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class Frozen(BaseLayerConfig):
+    """Freeze a wrapped layer (FrozenLayer.java parity): forward passes
+    through; parameters get zero updates and no regularization."""
+
+    layer_type = "frozen"
+    inner: Optional[BaseLayerConfig] = None
+
+    def with_n_in(self, input_type):
+        return self.replace(inner=self.inner.with_n_in(input_type))
+
+    def get_output_type(self, input_type):
+        return self.inner.get_output_type(input_type)
+
+    def has_params(self) -> bool:
+        return self.inner.has_params()
+
+    def replace(self, **kw):
+        # keep the wrapper's name in sync with the inner layer's
+        import dataclasses
+        if "name" in kw and self.inner is not None:
+            inner = dataclasses.replace(self.inner, name=kw["name"])
+            kw = dict(kw, inner=inner)
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def _decode_fields(cls, d):
+        if isinstance(d.get("inner"), dict):
+            d["inner"] = layer_from_dict(d["inner"])
+        return d
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.pretrain import FrozenLayerWrapper
+        return FrozenLayerWrapper(self, input_type, global_conf, policy)

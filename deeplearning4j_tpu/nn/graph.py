@@ -229,8 +229,16 @@ class ComputationGraph:
             if lrng is not None:
                 lrng, this_rng = jax.random.split(lrng)
             lm = None if lmasks is None else lmasks[i]
-            l = layer.loss(params.get(name, {}), xs[0], labels[i],
-                           train=train, rng=this_rng, mask=lm)
+            if getattr(layer, "loss_uses_state", False):
+                s_out = state.get(name, {})
+                l = layer.loss(params.get(name, {}), xs[0], labels[i],
+                               train=train, rng=this_rng, mask=lm, state=s_out)
+                if train and hasattr(layer, "update_centers"):
+                    new_state[name] = layer.update_centers(
+                        s_out, jax.lax.stop_gradient(xs[0]), labels[i])
+            else:
+                l = layer.loss(params.get(name, {}), xs[0], labels[i],
+                               train=train, rng=this_rng, mask=lm)
             total = l if total is None else total + l
         for layer in self.layers:
             if layer.name in params:
@@ -311,7 +319,8 @@ class ComputationGraph:
         for _ in range(epochs):
             for d in data:
                 self.fit_batch(d)
-            data.reset()
+            if hasattr(data, "reset"):
+                data.reset()
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1

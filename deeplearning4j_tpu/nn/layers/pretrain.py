@@ -1,0 +1,159 @@
+"""AutoEncoder, RBM, CenterLossOutput, Frozen runtime layers.
+
+Parity: nn/layers/feedforward/autoencoder/AutoEncoder.java (denoising AE),
+nn/layers/feedforward/rbm/RBM.java (contrastive divergence),
+nn/layers/training/CenterLossOutputLayer.java, nn/layers/FrozenLayer.java.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops import initializers as init_mod
+from deeplearning4j_tpu.ops import losses as losses_mod
+
+
+class AutoEncoderLayer(DenseLayer):
+    """Denoising autoencoder: encoder = the dense forward; pretrain loss
+    reconstructs the uncorrupted input through tied decoder params
+    (AutoEncoder.java: W' = W^T plus separate visible bias)."""
+
+    is_pretrainable = True
+
+    def init_params(self, key):
+        params = super().init_params(key)
+        params["vb"] = jnp.zeros((self.conf.n_in,), self.param_dtype)
+        return params
+
+    def pretrain_loss(self, params, x, rng):
+        c = self.conf
+        x = x.astype(self.param_dtype)
+        corrupted = x
+        if c.corruption_level > 0:
+            keep = jax.random.bernoulli(rng, 1.0 - c.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        h = self.activation_fn(self.preout(params, corrupted))
+        recon = h @ params["W"].T + params["vb"]
+        loss = losses_mod.get(c.loss)
+        return loss.score(x, recon, self.activation_fn, None)
+
+
+class RBMLayer(DenseLayer):
+    """Bernoulli-Bernoulli RBM (RBM.java parity, legacy). Pretraining uses
+    CD-k with the reparameterization-free gradient estimator: the positive
+    and negative phase statistics enter the loss via stop_gradient samples,
+    so autodiff reproduces the classic CD update."""
+
+    is_pretrainable = True
+
+    def init_params(self, key):
+        params = super().init_params(key)
+        params["vb"] = jnp.zeros((self.conf.n_in,), self.param_dtype)
+        return params
+
+    def _propup(self, params, v):
+        return jax.nn.sigmoid(v @ params["W"] + params.get(
+            "b", jnp.zeros((self.conf.n_out,), self.param_dtype)))
+
+    def _propdown(self, params, h):
+        return jax.nn.sigmoid(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        return self._propup(params, x.astype(self.param_dtype)), state
+
+    def _free_energy(self, params, v):
+        b = params.get("b", jnp.zeros((self.conf.n_out,), self.param_dtype))
+        wx_b = v @ params["W"] + b
+        return (-v @ params["vb"]
+                - jnp.sum(jax.nn.softplus(wx_b), axis=-1))
+
+    def pretrain_loss(self, params, x, rng):
+        """CD-k via the free-energy difference F(v_data) - F(v_model) with a
+        stop-gradient Gibbs chain — its gradient is the standard CD update."""
+        c = self.conf
+        v0 = x.astype(self.param_dtype)
+        v = v0
+        for step in range(c.k):
+            kh, kv = jax.random.split(jax.random.fold_in(rng, step))
+            h = jax.random.bernoulli(kh, self._propup(params, v)).astype(
+                v.dtype)
+            v = self._propdown(params, h)
+        v_model = jax.lax.stop_gradient(v)
+        return jnp.mean(self._free_energy(params, v0)
+                        - self._free_energy(params, v_model))
+
+
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (CenterLossOutputLayer.java):
+    total = dataLoss + lambda/2 * ||f - c_y||^2. Class centers live in layer
+    STATE and track class-mean features with an ``alpha`` moving average
+    (the reference folds the center update into the gradient step; the
+    moving-average form is the same fixed point, functional-style)."""
+
+    loss_uses_state = True
+
+    def init_state(self):
+        return {"centers": jnp.zeros(
+            (self.conf.n_out, self.conf.n_in), self.param_dtype)}
+
+    def loss(self, params, x, labels, *, train=False, rng=None, mask=None,
+             state=None):
+        base = super().loss(params, x, labels, train=train, rng=rng, mask=mask)
+        centers = state["centers"] if state is not None else None
+        if centers is None:
+            return base
+        c_y = labels @ centers  # one-hot selects each example's class center
+        center_term = 0.5 * self.conf.lmbda * jnp.mean(
+            jnp.sum((x - c_y) ** 2, axis=-1))
+        return base + center_term
+
+    def update_centers(self, state, x, labels):
+        """alpha moving-average center update (applied in the train step,
+        outside the differentiated loss)."""
+        centers = state["centers"]
+        counts = jnp.maximum(labels.sum(axis=0), 1.0)[:, None]
+        sums = labels.T @ x
+        batch_means = sums / counts
+        present = (labels.sum(axis=0) > 0)[:, None]
+        a = self.conf.alpha
+        new = jnp.where(present, (1 - a) * centers + a * batch_means, centers)
+        return {"centers": new}
+
+
+class FrozenLayerWrapper(Layer):
+    """Delegates forward to the wrapped layer; update-time freezing comes
+    from resolve('updater') -> NoOp and zero regularization."""
+
+    def __init__(self, conf, input_type, global_conf, policy):
+        super().__init__(conf, input_type, global_conf, policy)
+        self.inner = conf.inner.make_layer(input_type, global_conf, policy)
+
+    def resolve(self, name, default=None):
+        if name == "updater":
+            from deeplearning4j_tpu.nn.updater import NoOp
+            return NoOp()
+        return self.inner.resolve(name, default)
+
+    def init_params(self, key):
+        return self.inner.init_params(key)
+
+    def init_state(self):
+        return self.inner.init_state()
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.inner.apply(params, state, x, train=train, rng=rng,
+                                mask=mask)
+
+    def feed_forward_mask(self, mask):
+        return self.inner.feed_forward_mask(mask)
+
+    def regularization(self, params):
+        return jnp.zeros((), self.param_dtype)
+
+    def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
+        return self.inner.loss(params, x, labels, train=train, rng=rng,
+                               mask=mask)

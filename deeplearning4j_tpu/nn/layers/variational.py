@@ -1,0 +1,155 @@
+"""Variational autoencoder runtime layer.
+
+Parity: nn/layers/variational/VariationalAutoencoder.java (1,095 LoC) —
+encoder/decoder MLPs inside ONE layer, reparameterization trick, ELBO with a
+pluggable reconstruction distribution, own computeGradientAndScore (here:
+``pretrain_loss`` autodiffed inside the jitted pretrain step). Supervised
+``apply`` emits the posterior mean (the reference's activate()).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers_pretrain import (
+    BernoulliReconstruction,
+    CompositeReconstruction,
+    ExponentialReconstruction,
+    GaussianReconstruction,
+    LossWrapperReconstruction,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.ops import activations as act_mod
+from deeplearning4j_tpu.ops import initializers as init_mod
+from deeplearning4j_tpu.ops import losses as losses_mod
+
+
+def _neg_log_prob(dist, x, raw):
+    """-log p(x|z) summed over features, mean over batch. ``raw`` is the
+    reconstruction head's raw output (distribution parameters)."""
+    if isinstance(dist, BernoulliReconstruction):
+        p = jax.nn.sigmoid(raw)
+        eps = 1e-7
+        ll = x * jnp.log(p + eps) + (1 - x) * jnp.log(1 - p + eps)
+        return -jnp.mean(jnp.sum(ll, axis=-1))
+    if isinstance(dist, GaussianReconstruction):
+        n = x.shape[-1]
+        act = act_mod.get(dist.activation)
+        mean = act(raw[..., :n])
+        logvar = raw[..., n:]
+        ll = -0.5 * (math.log(2 * math.pi) + logvar
+                     + (x - mean) ** 2 / jnp.exp(logvar))
+        return -jnp.mean(jnp.sum(ll, axis=-1))
+    if isinstance(dist, ExponentialReconstruction):
+        gamma = raw  # log(lambda)
+        ll = gamma - jnp.exp(gamma) * x
+        return -jnp.mean(jnp.sum(ll, axis=-1))
+    if isinstance(dist, LossWrapperReconstruction):
+        loss = losses_mod.get(dist.loss)
+        return loss.score(x, raw, act_mod.get(dist.activation), None)
+    if isinstance(dist, CompositeReconstruction):
+        total = 0.0
+        x_off = p_off = 0
+        for n, inner in dist.distributions:
+            psize = inner.param_size(n)
+            total = total + _neg_log_prob(
+                inner, x[..., x_off:x_off + n], raw[..., p_off:p_off + psize])
+            x_off += n
+            p_off += psize
+        return total
+    raise TypeError(f"Unknown reconstruction distribution {type(dist)}")
+
+
+class VAELayer(Layer):
+    is_pretrainable = True
+
+    def _sizes(self):
+        c = self.conf
+        enc = [c.n_in, *c.encoder_layer_sizes]
+        dec = [c.n_out, *c.decoder_layer_sizes]
+        return enc, dec
+
+    def init_params(self, key):
+        c = self.conf
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
+        dt = self.param_dtype
+        enc, dec = self._sizes()
+        params = {}
+
+        def dense(key, n_in, n_out):
+            kW, _ = jax.random.split(key)
+            return {"W": w_fn(kW, (n_in, n_out), n_in, n_out, dt),
+                    "b": jnp.zeros((n_out,), dt)}
+
+        keys = jax.random.split(key, len(enc) + len(dec) + 3)
+        ki = 0
+        for i in range(len(enc) - 1):
+            params[f"enc{i}"] = dense(keys[ki], enc[i], enc[i + 1]); ki += 1
+        params["mean"] = dense(keys[ki], enc[-1], c.n_out); ki += 1
+        params["logvar"] = dense(keys[ki], enc[-1], c.n_out); ki += 1
+        for i in range(len(dec) - 1):
+            params[f"dec{i}"] = dense(keys[ki], dec[i], dec[i + 1]); ki += 1
+        psize = c.reconstruction.param_size(c.n_in)
+        params["recon"] = dense(keys[ki], dec[-1], psize)
+        return params
+
+    def _mlp(self, params, prefix, n_layers, x):
+        act = self.activation_fn
+        for i in range(n_layers):
+            p = params[f"{prefix}{i}"]
+            x = act(x @ p["W"] + p["b"])
+        return x
+
+    def encode(self, params, x):
+        c = self.conf
+        h = self._mlp(params, "enc", len(c.encoder_layer_sizes), x)
+        mean = h @ params["mean"]["W"] + params["mean"]["b"]
+        logvar = h @ params["logvar"]["W"] + params["logvar"]["b"]
+        return mean, logvar
+
+    def decode(self, params, z):
+        c = self.conf
+        d = self._mlp(params, "dec", len(c.decoder_layer_sizes), z)
+        return d @ params["recon"]["W"] + params["recon"]["b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        mean, _ = self.encode(params, x)
+        return mean, state  # activate() == pzxMean in the reference
+
+    def pretrain_loss(self, params, x, rng):
+        """-ELBO = reconstruction NLL + KL(q(z|x) || N(0, I)), averaged over
+        the batch (VariationalAutoencoder.computeGradientAndScore parity)."""
+        c = self.conf
+        x = x.astype(self.param_dtype)
+        mean, logvar = self.encode(params, x)
+        kl = -0.5 * jnp.sum(1 + logvar - mean ** 2 - jnp.exp(logvar), axis=-1)
+        recon = 0.0
+        for s in range(c.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape,
+                                    mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            recon = recon + _neg_log_prob(c.reconstruction, x,
+                                          self.decode(params, z))
+        return recon / c.num_samples + jnp.mean(kl)
+
+    def reconstruction_error(self, params, x, rng=None):
+        """Deterministic reconstruction NLL at the posterior mean
+        (reconstructionError parity — usable as an anomaly score)."""
+        mean, _ = self.encode(params, x)
+        return _neg_log_prob(self.conf.reconstruction, x,
+                             self.decode(params, mean))
+
+    def generate_at_mean_given_z(self, params, z):
+        """Decode latent codes (generateAtMeanGivenZ parity)."""
+        raw = self.decode(params, z)
+        dist = self.conf.reconstruction
+        if isinstance(dist, BernoulliReconstruction):
+            return jax.nn.sigmoid(raw)
+        if isinstance(dist, GaussianReconstruction):
+            n = raw.shape[-1] // 2
+            return act_mod.get(dist.activation)(raw[..., :n])
+        return raw

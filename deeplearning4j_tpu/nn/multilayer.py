@@ -215,8 +215,16 @@ class MultiLayerNetwork:
         if self.preprocessors[-1] is not None:
             h = self.preprocessors[-1](h)
         p_out = params.get(out_layer.name, {})
-        data_loss = out_layer.loss(p_out, h, labels, train=train, rng=lrng,
-                                   mask=lmask)
+        if getattr(out_layer, "loss_uses_state", False):
+            s_out = state.get(out_layer.name, {})
+            data_loss = out_layer.loss(p_out, h, labels, train=train,
+                                       rng=lrng, mask=lmask, state=s_out)
+            if train and hasattr(out_layer, "update_centers"):
+                new_state[out_layer.name] = out_layer.update_centers(
+                    s_out, jax.lax.stop_gradient(h), labels)
+        else:
+            data_loss = out_layer.loss(p_out, h, labels, train=train,
+                                       rng=lrng, mask=lmask)
         reg = jnp.zeros((), data_loss.dtype)
         for layer in self.layers:
             if layer.name in params:
@@ -387,6 +395,66 @@ class MultiLayerNetwork:
                 l.on_epoch_end(self)
             self.epoch += 1
             it.reset()
+        return self
+
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
+        """Layer-wise unsupervised pretraining (MultiLayerNetwork.pretrain
+        :963): each pretrainable layer (VAE/AutoEncoder/RBM) trains on the
+        activations of the layers below it."""
+        self._require_init()
+        if isinstance(data, DataSetIterator):
+            it = data
+        elif isinstance(data, DataSet):
+            it = ListDataSetIterator([data])
+        else:
+            it = ArrayDataSetIterator(data, None, batch_size=batch_size)
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "is_pretrainable", False):
+                self.pretrain_layer(i, it, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, idx: int, iterator, *, epochs: int = 1):
+        """Pretrain one layer on its (preprocessed) input activations; the
+        loss is the layer's own unsupervised objective
+        (pretrain_loss: -ELBO for VAE, reconstruction for AE, CD free-energy
+        difference for RBM), compiled into one jitted step."""
+        layer = self.layers[idx]
+        if not getattr(layer, "is_pretrainable", False):
+            raise ValueError(f"Layer {idx} ({layer.conf.layer_type}) is not "
+                             f"pretrainable")
+        gc = self.conf.global_conf
+        name = layer.name
+
+        def step(params, opt_state, itc, x, rng):
+            def loss_fn(p):
+                return layer.pretrain_loss(p[name], x, rng)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = apply_layer_updates(
+                [layer], gc, params, grads, opt_state, itc)
+            return new_params, new_opt, loss
+
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        params_sub = {name: self.params[name]}
+        opt_sub = {name: self.opt_state[name]}
+        last = None
+        for _ in range(epochs):
+            for ds in iterator:
+                x = jnp.asarray(ds.features)
+                # activations of the stack below + this layer's preprocessor
+                if idx > 0:
+                    x, _ = self._forward(self.params, self.state, x,
+                                         train=False, rng=None, to_layer=idx)
+                if self.preprocessors[idx] is not None:
+                    x = self.preprocessors[idx](x)
+                self._rng_key, rng = jax.random.split(self._rng_key)
+                itc = jnp.asarray(self.iteration, jnp.int32)
+                params_sub, opt_sub, last = jitted(params_sub, opt_sub, itc,
+                                                   x, rng)
+            iterator.reset()
+        self.params = {**self.params, name: params_sub[name]}
+        self.opt_state = {**self.opt_state, name: opt_sub[name]}
+        self.score_value = last
         return self
 
     # ------------------------------------------------------------ inference

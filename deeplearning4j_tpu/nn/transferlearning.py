@@ -1,0 +1,185 @@
+"""Transfer learning: clone-and-edit trained networks.
+
+Parity: nn/transferlearning/{TransferLearning, FineTuneConfiguration,
+TransferLearningHelper}.java (SURVEY.md §2.3) — fine-tune overrides, freeze
+prefixes (wrapping layers in Frozen), output replacement, n_out surgery with
+re-initialization, and featurization through the frozen boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.core import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.layers_pretrain import Frozen
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclass(frozen=True)
+class FineTuneConfiguration:
+    """Subset of NeuralNetConfiguration fields to override on the new net
+    (FineTuneConfiguration.java parity); None = keep the original value."""
+
+    seed: Optional[int] = None
+    activation: Optional[str] = None
+    weight_init: Optional[Any] = None
+    learning_rate: Optional[float] = None
+    updater: Optional[Any] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    def apply_to(self, gc: NeuralNetConfiguration) -> NeuralNetConfiguration:
+        overrides = {f.name: getattr(self, f.name)
+                     for f in dataclasses.fields(self)
+                     if getattr(self, f.name) is not None}
+        return gc.replace(**overrides)
+
+
+class TransferLearningBuilder:
+    """TransferLearning.Builder parity: freeze a prefix, drop/replace the
+    tail, change n_out, fine-tune hyperparameters — weights of kept layers
+    are copied, edited/new layers re-initialize."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        self._net = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        # (conf, carry_weights) per retained layer, resolved shapes
+        self._layers = [(c, True) for c in net._resolved_confs]
+        self._input_type = net.conf.input_type
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, layer: int | str):
+        """Freeze layers [0..layer] inclusive (setFeatureExtractor parity)."""
+        self._freeze_until = self._index_of(layer)
+        return self
+
+    def _index_of(self, layer: int | str) -> int:
+        if isinstance(layer, int):
+            return layer
+        for i, (c, _) in enumerate(self._layers):
+            if c.name == layer:
+                return i
+        raise ValueError(f"No layer named '{layer}'")
+
+    def remove_output_layer(self):
+        self._layers = self._layers[:-1]
+        return self
+
+    def remove_layers_from(self, layer: int | str):
+        self._layers = self._layers[:self._index_of(layer)]
+        return self
+
+    def add_layer(self, conf):
+        self._layers.append((conf, False))
+        return self
+
+    def n_out_replace(self, layer: int | str, n_out: int,
+                      weight_init: Any = None):
+        """Change a layer's n_out; that layer and the next re-initialize
+        (nOutReplace parity)."""
+        i = self._index_of(layer)
+        conf, _ = self._layers[i]
+        kw = {"n_out": n_out}
+        if weight_init is not None:
+            kw["weight_init"] = weight_init
+        self._layers[i] = (conf.replace(**kw), False)
+        if i + 1 < len(self._layers):
+            nxt, _ = self._layers[i + 1]
+            self._layers[i + 1] = (nxt.replace(n_in=None), False)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        gc = self._net.conf.global_conf
+        if self._fine_tune is not None:
+            gc = self._fine_tune.apply_to(gc)
+        confs = []
+        carry = []
+        for i, (conf, keep) in enumerate(self._layers):
+            if self._freeze_until is not None and i <= self._freeze_until:
+                conf = Frozen(inner=conf, name=conf.name)
+            confs.append(conf)
+            carry.append(keep)
+        new_conf = MultiLayerConfiguration(
+            global_conf=gc,
+            layers=tuple(confs),
+            input_type=self._input_type,
+            backprop_type=self._net.conf.backprop_type,
+            tbptt_fwd_length=self._net.conf.tbptt_fwd_length,
+            tbptt_bwd_length=self._net.conf.tbptt_bwd_length,
+            preprocessors=dict(self._net.conf.preprocessors),
+        )
+        new_net = MultiLayerNetwork(new_conf).init()
+        # copy weights for retained layers (by name)
+        for i, (conf, keep) in enumerate(self._layers):
+            if not keep:
+                continue
+            name = conf.name
+            if name in self._net.params and name in new_net.params:
+                new_net.params[name] = jax.tree_util.tree_map(
+                    jnp.copy, self._net.params[name])
+            if name in (self._net.state or {}) and name in (new_net.state or {}):
+                new_net.state[name] = jax.tree_util.tree_map(
+                    jnp.copy, self._net.state[name])
+        return new_net
+
+
+class TransferLearning:
+    Builder = TransferLearningBuilder
+
+
+class TransferLearningHelper:
+    """Featurize inputs through the frozen prefix so the unfrozen tail can
+    be trained on cached features (TransferLearningHelper.java parity)."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int | str):
+        self.net = net
+        if isinstance(frozen_until, str):
+            names = [l.name for l in net.layers]
+            frozen_until = names.index(frozen_until)
+        self.frozen_until = frozen_until
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        x = jnp.asarray(ds.features)
+        fmask = (None if ds.features_mask is None
+                 else jnp.asarray(ds.features_mask))
+        h, _ = self.net._forward(self.net.params, self.net.state, x,
+                                 train=False, rng=None, fmask=fmask,
+                                 to_layer=self.frozen_until + 1)
+        return DataSet(h, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def unfrozen_net(self) -> MultiLayerNetwork:
+        """A tail network (layers after the frozen boundary) sharing this
+        net's configs, with weights copied in."""
+        confs = self.net._resolved_confs[self.frozen_until + 1:]
+        tail_conf = MultiLayerConfiguration(
+            global_conf=self.net.conf.global_conf,
+            layers=tuple(confs),
+        )
+        tail = MultiLayerNetwork(tail_conf).init()
+        for c in confs:
+            if c.name in self.net.params:
+                tail.params[c.name] = jax.tree_util.tree_map(
+                    jnp.copy, self.net.params[c.name])
+        return tail
+
+    def copy_back(self, tail: MultiLayerNetwork):
+        """Write a trained tail's weights back into the full net."""
+        for name, p in tail.params.items():
+            self.net.params[name] = jax.tree_util.tree_map(jnp.copy, p)
+        return self.net

@@ -1,0 +1,325 @@
+"""Early stopping: config, termination conditions, score calculators,
+model savers, trainer.
+
+Parity: earlystopping/ in the reference — EarlyStoppingConfiguration.java,
+trainer/EarlyStoppingTrainer.java (+Graph variant; one trainer here handles
+both since the model API is shared), scorecalc/DataSetLossCalculator.java,
+termination/ (MaxEpochs, BestScoreEpoch, ScoreImprovementEpoch, MaxTime,
+MaxScore, InvalidScore epoch+iteration conditions), saver/ (LocalFile +
+InMemory), listener/EarlyStoppingListener.java.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# Termination conditions (termination/ parity: 8 conditions)
+# ---------------------------------------------------------------------------
+
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, iteration: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+@dataclass
+class MaxEpochsTermination(EpochTerminationCondition):
+    max_epochs: int = 10
+
+    def terminate(self, epoch, score):
+        return epoch >= self.max_epochs - 1
+
+
+@dataclass
+class BestScoreEpochTermination(EpochTerminationCondition):
+    """Stop once the score reaches/beats a target value."""
+
+    best_expected_score: float = 0.0
+
+    def terminate(self, epoch, score):
+        return score <= self.best_expected_score
+
+
+@dataclass
+class ScoreImprovementEpochTermination(EpochTerminationCondition):
+    """Stop after max_epochs_without_improvement (optionally requiring at
+    least min_improvement)."""
+
+    max_epochs_without_improvement: int = 5
+    min_improvement: float = 0.0
+
+    def initialize(self):
+        self._best = math.inf
+        self._since = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._since = 0
+            return False
+        self._since += 1
+        return self._since > self.max_epochs_without_improvement
+
+
+@dataclass
+class MaxScoreEpochTermination(EpochTerminationCondition):
+    """Stop (diverged) if the score exceeds max_score."""
+
+    max_score: float = 1e9
+
+    def terminate(self, epoch, score):
+        return score > self.max_score
+
+
+@dataclass
+class InvalidScoreEpochTermination(EpochTerminationCondition):
+    def terminate(self, epoch, score):
+        return math.isnan(score) or math.isinf(score)
+
+
+@dataclass
+class MaxTimeIterationTermination(IterationTerminationCondition):
+    max_seconds: float = 3600.0
+
+    def initialize(self):
+        self._start = time.time()
+
+    def terminate(self, iteration, score):
+        return (time.time() - self._start) > self.max_seconds
+
+
+@dataclass
+class MaxScoreIterationTermination(IterationTerminationCondition):
+    max_score: float = 1e9
+
+    def terminate(self, iteration, score):
+        return score > self.max_score
+
+
+@dataclass
+class InvalidScoreIterationTermination(IterationTerminationCondition):
+    def terminate(self, iteration, score):
+        return math.isnan(score) or math.isinf(score)
+
+
+# ---------------------------------------------------------------------------
+# Score calculators (scorecalc/ parity)
+# ---------------------------------------------------------------------------
+
+class DataSetLossCalculator:
+    """Average loss over a validation iterator
+    (DataSetLossCalculator.java parity; works for MLN and CG)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        total, count = 0.0, 0
+        for ds in self.iterator:
+            n = ds.num_examples
+            total += net.score(ds) * n
+            count += n
+        self.iterator.reset()
+        if count == 0:
+            return float("nan")
+        return total / count if self.average else total
+
+
+class EvaluationScoreCalculator:
+    """Score = 1 - accuracy (so 'minimize' semantics hold)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, net) -> float:
+        ev = net.evaluate(self.iterator)
+        self.iterator.reset()
+        return 1.0 - ev.accuracy()
+
+
+# ---------------------------------------------------------------------------
+# Model savers (saver/ parity)
+# ---------------------------------------------------------------------------
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best(self, net):
+        self.best = net.clone()
+
+    def save_latest(self, net):
+        self.latest = net.clone()
+
+    def get_best(self):
+        return self.best
+
+    def get_latest(self):
+        return self.latest
+
+
+class LocalFileModelSaver:
+    """Writes bestModel.zip / latestModel.zip via the checkpoint format
+    (LocalFileModelSaver.java parity)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _write(self, net, fname):
+        from deeplearning4j_tpu.utils.serialization import (
+            write_computation_graph, write_model)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        path = os.path.join(self.directory, fname)
+        if isinstance(net, MultiLayerNetwork):
+            write_model(net, path)
+        else:
+            write_computation_graph(net, path)
+        return path
+
+    def save_best(self, net):
+        self._write(net, "bestModel.zip")
+
+    def save_latest(self, net):
+        self._write(net, "latestModel.zip")
+
+    def get_best(self):
+        from deeplearning4j_tpu.utils.serialization import restore_model
+        return restore_model(os.path.join(self.directory, "bestModel.zip"))
+
+    def get_latest(self):
+        from deeplearning4j_tpu.utils.serialization import restore_model
+        return restore_model(os.path.join(self.directory, "latestModel.zip"))
+
+
+# ---------------------------------------------------------------------------
+# Configuration + result + trainer
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: object = None
+    epoch_terminations: List[EpochTerminationCondition] = field(
+        default_factory=list)
+    iteration_terminations: List[IterationTerminationCondition] = field(
+        default_factory=list)
+    model_saver: object = field(default_factory=InMemoryModelSaver)
+    save_last_model: bool = False
+    evaluate_every_n_epochs: int = 1
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str
+    termination_details: str
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: object = None
+    score_vs_epoch: dict = field(default_factory=dict)
+
+
+class EarlyStoppingTrainer:
+    """Epoch loop around fit + validation scoring + best-model saving
+    (trainer/EarlyStoppingTrainer.java parity; handles MultiLayerNetwork and
+    ComputationGraph — the 'GraphTrainer' of the reference is the same loop)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator,
+                 listener=None):
+        self.config = config
+        self.net = net
+        self.iterator = train_iterator
+        self.listener = listener
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_terminations:
+            c.initialize()
+        for c in cfg.iteration_terminations:
+            c.initialize()
+        best_score, best_epoch = math.inf, -1
+        scores = {}
+        epoch = 0
+        reason, details = "max_epochs", "no epoch termination configured"
+        if self.listener:
+            self.listener.on_start(cfg, self.net)
+        while True:
+            stop_iter = None
+            for ds in self.iterator:
+                score = float(self.net.fit_batch(ds))
+                for c in cfg.iteration_terminations:
+                    if c.terminate(self.net.iteration, score):
+                        stop_iter = (type(c).__name__,
+                                     f"iteration {self.net.iteration}, "
+                                     f"score {score}")
+                        break
+                if stop_iter:
+                    break
+            self.iterator.reset()
+            if stop_iter:
+                reason, details = stop_iter
+                break
+
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(self.net)
+                else:
+                    score = float(self.net.score_value)
+                scores[epoch] = score
+                if self.listener:
+                    self.listener.on_epoch(epoch, score, cfg, self.net)
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best(self.net)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest(self.net)
+            else:
+                # off-schedule epochs still check terminations, against the
+                # latest training score (the reference checks every epoch)
+                score = float(self.net.score_value)
+            stop_epoch = None
+            for c in cfg.epoch_terminations:
+                if c.terminate(epoch, score):
+                    stop_epoch = (type(c).__name__,
+                                  f"epoch {epoch}, score {score}")
+                    break
+            if stop_epoch:
+                reason, details = stop_epoch
+                break
+            self.net.epoch += 1
+            epoch += 1
+
+        result = EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            total_epochs=epoch + 1,
+            best_model=cfg.model_saver.get_best(),
+            score_vs_epoch=scores,
+        )
+        if self.listener:
+            self.listener.on_completion(result)
+        return result
+
+
+# Reference-name alias: the Graph variant is the same trainer.
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

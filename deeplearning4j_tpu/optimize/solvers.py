@@ -1,0 +1,195 @@
+"""Second-order-ish full-batch optimizers: line gradient descent, conjugate
+gradient, L-BFGS, with Armijo backtracking line search.
+
+Parity: optimize/solvers/{BaseOptimizer, StochasticGradientDescent, LBFGS,
+ConjugateGradient, LineGradientDescent, BackTrackLineSearch}.java +
+optimize/Solver.java (SURVEY.md §2.4). The SGD path is the jitted train
+step inside MultiLayerNetwork/ComputationGraph; these drivers cover the
+reference's remaining OptimizationAlgorithm values. TPU-native design:
+parameters are raveled to ONE flat vector (jax.flatten_util) and the
+loss/grad are jitted once — every line-search probe is a single compiled
+device call, the host only steers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+@dataclass
+class SolverResult:
+    score: float
+    iterations: int
+    converged: bool
+
+
+def _flat_problem(net, ds):
+    flat0, unravel = ravel_pytree(net.params)
+    x = jnp.asarray(ds.features)
+    y = jnp.asarray(ds.labels)
+    fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+    lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+    # Fixed rng: line search needs a deterministic objective, so a dropout
+    # net optimizes one fixed mask per optimize() call.
+    rng = jax.random.PRNGKey(int(np.asarray(net._rng_key)[-1]))
+
+    def loss(flat):
+        l, _ = net._loss(unravel(flat), net.state, x, y, fmask, lmask,
+                         rng=rng, train=True)
+        return l
+
+    return flat0, unravel, jax.jit(loss), jax.jit(jax.value_and_grad(loss))
+
+
+def backtrack_line_search(loss_fn, x, fx, g, direction, *, step0=1.0,
+                          c1=1e-4, rho=0.5, max_steps=30):
+    """Armijo backtracking (BackTrackLineSearch.java parity, 369 LoC there):
+    shrink step until f(x + a*d) <= f(x) + c1*a*g.d.
+
+    Returns (step, f_new, direction) — the direction is swapped to -g when
+    the supplied one is not a descent direction, so callers MUST step along
+    the returned direction."""
+    gd = float(g @ direction)
+    if gd >= 0:  # not a descent direction — fall back to -g
+        direction = -g
+        gd = float(g @ direction)
+    a = step0
+    for _ in range(max_steps):
+        fnew = float(loss_fn(x + a * direction))
+        if fnew <= fx + c1 * a * gd and np.isfinite(fnew):
+            return a, fnew, direction
+        a *= rho
+    return 0.0, fx, direction  # no acceptable step
+
+
+class BaseSolver:
+    """Template loop (BaseOptimizer.optimize :180 parity): direction ->
+    line search -> update, until max_iterations or gradient/score tolerance."""
+
+    def __init__(self, net, max_iterations: int = 100, tolerance: float = 1e-8):
+        self.net = net
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+
+    def _directions(self, flat0, loss, vg):
+        raise NotImplementedError
+
+    def optimize(self, ds) -> SolverResult:
+        flat0, unravel, loss, vg = _flat_problem(self.net, ds)
+        flat, iters, converged = self._run(flat0, loss, vg)
+        self.net.params = unravel(flat)
+        score = float(loss(flat))
+        self.net.score_value = score
+        return SolverResult(score=score, iterations=iters, converged=converged)
+
+
+class LineGradientDescent(BaseSolver):
+    """Steepest descent + line search (LineGradientDescent.java parity)."""
+
+    def _run(self, flat, loss, vg):
+        fx, g = vg(flat)
+        fx = float(fx)
+        for i in range(self.max_iterations):
+            a, fnew, d = backtrack_line_search(loss, flat, fx, g, -g)
+            if a == 0.0 or abs(fx - fnew) < self.tolerance:
+                return flat, i + 1, True
+            flat = flat + a * d
+            fx, g = vg(flat)
+            fx = float(fx)
+        return flat, self.max_iterations, False
+
+
+class ConjugateGradient(BaseSolver):
+    """Nonlinear CG, Polak-Ribiere+ with automatic restart
+    (ConjugateGradient.java parity)."""
+
+    def _run(self, flat, loss, vg):
+        fx, g = vg(flat)
+        fx = float(fx)
+        d = -g
+        for i in range(self.max_iterations):
+            a, fnew, d = backtrack_line_search(loss, flat, fx, g, d)
+            if a == 0.0 or abs(fx - fnew) < self.tolerance:
+                return flat, i + 1, True
+            flat = flat + a * d
+            fx_new, g_new = vg(flat)
+            beta = float(g_new @ (g_new - g)) / max(float(g @ g), 1e-20)
+            beta = max(beta, 0.0)  # PR+ restart
+            d = -g_new + beta * d
+            fx, g = float(fx_new), g_new
+        return flat, self.max_iterations, False
+
+
+class LBFGS(BaseSolver):
+    """Limited-memory BFGS, two-loop recursion, memory m
+    (LBFGS.java parity — the reference also uses m=10 ringbuffers)."""
+
+    def __init__(self, net, max_iterations: int = 100, tolerance: float = 1e-8,
+                 m: int = 10):
+        super().__init__(net, max_iterations, tolerance)
+        self.m = m
+
+    def _run(self, flat, loss, vg):
+        fx, g = vg(flat)
+        fx = float(fx)
+        s_hist, y_hist = [], []
+        for i in range(self.max_iterations):
+            # two-loop recursion
+            q = np.asarray(g, dtype=np.float64).copy()
+            alphas = []
+            for s, y in reversed(list(zip(s_hist, y_hist))):
+                rho = 1.0 / max(float(y @ s), 1e-20)
+                a = rho * float(s @ q)
+                alphas.append((a, rho, s, y))
+                q -= a * np.asarray(y)
+            if y_hist:
+                s, y = s_hist[-1], y_hist[-1]
+                gamma = float(s @ y) / max(float(y @ y), 1e-20)
+                q *= gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(y @ q)
+                q += np.asarray(s) * (a - b)
+            d = jnp.asarray(-q, dtype=flat.dtype)
+
+            a, fnew, d = backtrack_line_search(loss, flat, fx, g, d)
+            if a == 0.0 or abs(fx - fnew) < self.tolerance:
+                return flat, i + 1, True
+            new_flat = flat + a * d
+            fx_new, g_new = vg(new_flat)
+            s_hist.append(new_flat - flat)
+            y_hist.append(g_new - g)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            flat, fx, g = new_flat, float(fx_new), g_new
+        return flat, self.max_iterations, False
+
+
+class Solver:
+    """Dispatch by algorithm name (optimize/Solver.java :48 parity).
+    'sgd' is the jitted minibatch train step on the network itself."""
+
+    ALGOS = {
+        "line_gradient_descent": LineGradientDescent,
+        "conjugate_gradient": ConjugateGradient,
+        "lbfgs": LBFGS,
+    }
+
+    def __init__(self, net):
+        self.net = net
+
+    def optimize(self, ds, algo: str = "lbfgs", **kwargs) -> SolverResult:
+        if algo in ("sgd", "stochastic_gradient_descent"):
+            score = self.net.fit_batch(ds)
+            return SolverResult(score=float(score), iterations=1,
+                                converged=False)
+        cls = self.ALGOS.get(algo)
+        if cls is None:
+            raise ValueError(f"Unknown optimization algorithm '{algo}'; "
+                             f"one of {sorted(self.ALGOS)} or 'sgd'")
+        return cls(self.net, **kwargs).optimize(ds)

@@ -113,6 +113,20 @@ def write_computation_graph(net, path, save_updater: bool = True):
     _write(net, path, "computation_graph", save_updater)
 
 
+def restore_model(path, load_updater: bool = True):
+    """Restore either model kind by reading metadata.json's model_type
+    (ModelGuesser.java parity)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+        mtype = "multi_layer_network"
+        if "metadata.json" in names:
+            mtype = json.loads(zf.read("metadata.json")).get(
+                "model_type", mtype)
+    if mtype == "computation_graph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)
+
+
 def restore_computation_graph(path, load_updater: bool = True):
     """ModelSerializer.restoreComputationGraph parity."""
     try:

@@ -1,0 +1,161 @@
+"""Early stopping (termination conditions, savers, trainer loop) and
+full-batch solver tests (LBFGS/CG/line search converge on a convex-ish
+problem and beat plain SGD iterations)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.optimize.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreEpochTermination,
+    LocalFileModelSaver,
+    MaxEpochsTermination,
+    MaxScoreEpochTermination,
+    MaxTimeIterationTermination,
+    ScoreImprovementEpochTermination,
+)
+from deeplearning4j_tpu.optimize.solvers import (
+    ConjugateGradient,
+    LBFGS,
+    LineGradientDescent,
+    Solver,
+)
+
+
+def make_problem(seed=0, n=256):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 2, (3, 5))
+    idx = rng.integers(0, 3, n)
+    x = centers[idx] + rng.normal(0, 0.6, (n, 5))
+    y = np.eye(3)[idx]
+    return x, y
+
+
+def make_net(lr=1e-2, updater=None):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(updater or Adam(lr)).list()
+            .layer(Dense(n_in=5, n_out=16, activation="tanh"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------------ terminations
+def test_termination_conditions():
+    assert MaxEpochsTermination(3).terminate(2, 1.0)
+    assert not MaxEpochsTermination(3).terminate(1, 1.0)
+    assert MaxScoreEpochTermination(5.0).terminate(0, 6.0)
+    assert InvalidScoreEpochTermination().terminate(0, float("nan"))
+    assert InvalidScoreEpochTermination().terminate(0, float("inf"))
+    c = ScoreImprovementEpochTermination(2)
+    c.initialize()
+    assert not c.terminate(0, 1.0)
+    assert not c.terminate(1, 0.9)   # improved
+    assert not c.terminate(2, 0.95)  # 1 without improvement
+    assert not c.terminate(3, 0.92)  # 2 without improvement
+    assert c.terminate(4, 0.91)      # 3 > max of 2
+    t = MaxTimeIterationTermination(max_seconds=0.0)
+    t.initialize()
+    assert t.terminate(0, 1.0)
+
+
+# ----------------------------------------------------------------- trainer
+def test_early_stopping_trainer_max_epochs_and_best_model():
+    x, y = make_problem()
+    net = make_net()
+    saver = InMemoryModelSaver()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(x, y, batch_size=128)),
+        epoch_terminations=[MaxEpochsTermination(8)],
+        model_saver=saver,
+    )
+    trainer = EarlyStoppingTrainer(
+        cfg, net, ArrayDataSetIterator(x, y, batch_size=64))
+    result = trainer.fit()
+    assert result.termination_reason == "MaxEpochsTermination"
+    assert result.total_epochs == 8
+    assert result.best_model is not None
+    assert result.best_model_score <= min(result.score_vs_epoch.values()) + 1e-9
+    # best model actually scores what was recorded
+    calc = DataSetLossCalculator(ArrayDataSetIterator(x, y, batch_size=128))
+    assert abs(calc.calculate_score(result.best_model)
+               - result.best_model_score) < 1e-5
+
+
+def test_early_stopping_stops_on_no_improvement():
+    x, y = make_problem()
+    net = make_net(updater=Sgd(1e-6))  # lr so small nothing improves
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(x, y, batch_size=128)),
+        epoch_terminations=[
+            ScoreImprovementEpochTermination(2, min_improvement=1e-3),
+            MaxEpochsTermination(50),
+        ],
+    )
+    result = EarlyStoppingTrainer(
+        cfg, net, ArrayDataSetIterator(x, y, batch_size=64)).fit()
+    assert result.termination_reason == "ScoreImprovementEpochTermination"
+    assert result.total_epochs < 50
+
+
+def test_local_file_saver_round_trip(tmp_path):
+    x, y = make_problem()
+    net = make_net()
+    saver = LocalFileModelSaver(str(tmp_path))
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(
+            ArrayDataSetIterator(x, y, batch_size=128)),
+        epoch_terminations=[MaxEpochsTermination(2)],
+        model_saver=saver,
+    )
+    EarlyStoppingTrainer(cfg, net,
+                         ArrayDataSetIterator(x, y, batch_size=64)).fit()
+    best = saver.get_best()
+    assert np.asarray(best.output(x[:4])).shape == (4, 3)
+
+
+# ----------------------------------------------------------------- solvers
+@pytest.mark.parametrize("cls", [LineGradientDescent, ConjugateGradient, LBFGS])
+def test_solver_reduces_loss(cls):
+    x, y = make_problem()
+    ds = DataSet(x, y)
+    net = make_net()
+    s0 = net.score(ds, train=True)
+    res = cls(net, max_iterations=30).optimize(ds)
+    assert res.score < s0 * 0.5, (s0, res.score)
+
+
+def test_lbfgs_beats_sgd_per_iteration():
+    """On a full-batch convex-ish problem L-BFGS should reach a much lower
+    loss in 30 iterations than 30 SGD steps."""
+    x, y = make_problem()
+    ds = DataSet(x, y)
+    net_sgd = make_net(updater=Sgd(0.1))
+    for _ in range(30):
+        net_sgd.fit_batch(ds)
+    sgd_score = net_sgd.score(ds, train=True)
+
+    net_lbfgs = make_net()
+    res = LBFGS(net_lbfgs, max_iterations=30).optimize(ds)
+    assert res.score < sgd_score, (res.score, sgd_score)
+
+
+def test_solver_dispatch():
+    x, y = make_problem()
+    ds = DataSet(x, y)
+    net = make_net()
+    res = Solver(net).optimize(ds, algo="conjugate_gradient",
+                               max_iterations=10)
+    assert res.iterations <= 10
+    with pytest.raises(ValueError, match="Unknown optimization"):
+        Solver(net).optimize(ds, algo="newton")

@@ -1,0 +1,303 @@
+"""VAE / AutoEncoder / RBM pretraining, center loss, frozen layers, and
+transfer learning (VaeGradientCheckTests + TransferLearning tests
+analogue)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.conf.layers_pretrain import (
+    AutoEncoder,
+    BernoulliReconstruction,
+    CenterLossOutput,
+    CompositeReconstruction,
+    Frozen,
+    GaussianReconstruction,
+    LossWrapperReconstruction,
+    RBM,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.utils.gradient_check import gradient_check_fn
+
+F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+
+
+def binary_ds(n=16, dim=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataSet((rng.random((n, dim)) > 0.5).astype(float))
+
+
+# ------------------------------------------------------------------- VAE
+@pytest.mark.parametrize("recon,data", [
+    (BernoulliReconstruction(), "binary"),
+    (GaussianReconstruction(), "real"),
+    (LossWrapperReconstruction(loss="mse"), "real"),
+    (CompositeReconstruction(distributions=(
+        (3, BernoulliReconstruction()), (3, GaussianReconstruction()))),
+     "binary"),
+])
+def test_vae_elbo_gradients(recon, data):
+    """VaeGradientCheckTests analogue: check d(-ELBO)/d(params) for each
+    reconstruction distribution."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.05)).dtype(F64).list()
+            .layer(VariationalAutoencoder(
+                n_in=6, n_out=3, encoder_layer_sizes=(7,),
+                decoder_layer_sizes=(7,), reconstruction=recon,
+                activation="tanh"))
+            .layer(Output(n_in=3, n_out=2, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray((rng.random((8, 6)) > 0.5).astype(float) if data == "binary"
+                    else rng.normal(size=(8, 6)))
+    vae = net.layers[0]
+    key = jax.random.PRNGKey(7)
+
+    def loss_fn(params):
+        return vae.pretrain_loss(params, x, key)
+
+    res = gradient_check_fn(loss_fn, net.params[vae.name],
+                            min_abs_error=1e-9, sample_per_leaf=25)
+    assert res.passed, res.failures[:5]
+
+
+def test_vae_pretrain_reduces_reconstruction_error():
+    rng = np.random.default_rng(0)
+    # structured binary data: two prototype patterns + flip noise
+    protos = (rng.random((2, 10)) > 0.5).astype(float)
+    idx = rng.integers(0, 2, 128)
+    x = protos[idx].copy()
+    flip = rng.random(x.shape) < 0.05
+    x[flip] = 1 - x[flip]
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2)).list()
+            .layer(VariationalAutoencoder(
+                n_in=10, n_out=2, encoder_layer_sizes=(16,),
+                decoder_layer_sizes=(16,), activation="tanh"))
+            .layer(Output(n_in=2, n_out=2, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    vae = net.layers[0]
+    err0 = float(vae.reconstruction_error(net.params[vae.name],
+                                          jnp.asarray(x)))
+    net.pretrain(ArrayDataSetIterator(x, None, batch_size=32), epochs=30)
+    err1 = float(vae.reconstruction_error(net.params[vae.name],
+                                          jnp.asarray(x)))
+    assert err1 < err0 * 0.7, (err0, err1)
+    # latent decode works
+    gen = vae.generate_at_mean_given_z(net.params[vae.name],
+                                       jnp.zeros((4, 2)))
+    assert gen.shape == (4, 10)
+
+
+# ------------------------------------------------------- AutoEncoder / RBM
+def test_autoencoder_pretrain_learns_reconstruction():
+    rng = np.random.default_rng(0)
+    basis = rng.normal(size=(3, 12))
+    codes = rng.normal(size=(128, 3))
+    x = codes @ basis + 0.05 * rng.normal(size=(128, 12))
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(1e-2)).list()
+            .layer(AutoEncoder(n_in=12, n_out=3, activation="identity",
+                               corruption_level=0.1, loss="mse"))
+            .layer(Output(n_in=3, n_out=2, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ae = net.layers[0]
+    key = jax.random.PRNGKey(0)
+    l0 = float(ae.pretrain_loss(net.params[ae.name], jnp.asarray(x), key))
+    net.pretrain(ArrayDataSetIterator(x, None, batch_size=32), epochs=40)
+    l1 = float(ae.pretrain_loss(net.params[ae.name], jnp.asarray(x), key))
+    assert l1 < l0 * 0.5, (l0, l1)
+
+
+def test_rbm_pretrain_runs_and_improves_free_energy_gap():
+    rng = np.random.default_rng(0)
+    protos = (rng.random((2, 8)) > 0.5).astype(float)
+    x = protos[rng.integers(0, 2, 64)]
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater(Sgd(0.1)).list()
+            .layer(RBM(n_in=8, n_out=4, k=1))
+            .layer(Output(n_in=4, n_out=2, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rbm = net.layers[0]
+    # data free energy should drop relative to random noise after training
+    noise = (rng.random((64, 8)) > 0.5).astype(float)
+    def gap(params):
+        return float(jnp.mean(rbm._free_energy(params, jnp.asarray(x)))
+                     - jnp.mean(rbm._free_energy(params, jnp.asarray(noise))))
+    g0 = gap(net.params[rbm.name])
+    net.pretrain(ArrayDataSetIterator(x, None, batch_size=32), epochs=30)
+    g1 = gap(net.params[rbm.name])
+    assert g1 < g0, (g0, g1)
+    # forward = propup probabilities in [0, 1]
+    out = np.asarray(net.layers[0].apply(
+        net.params[rbm.name], {}, jnp.asarray(x))[0])
+    assert out.min() >= 0 and out.max() <= 1
+
+
+# ------------------------------------------------------------- center loss
+def test_center_loss_gradients_and_center_updates():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.1)).dtype(F64).list()
+            .layer(Dense(n_in=5, n_out=4, activation="tanh"))
+            .layer(CenterLossOutput(n_out=3, activation="softmax",
+                                    lmbda=0.1, alpha=0.2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 5)), np.eye(3)[rng.integers(0, 3, 8)])
+
+    from deeplearning4j_tpu.utils.gradient_check import check_network_gradients
+    res = check_network_gradients(net, ds, sample_per_leaf=40)
+    assert res.passed, res.failures[:5]
+
+    name = net.layers[1].name
+    c0 = np.asarray(net.state[name]["centers"]).copy()
+    net.fit_batch(ds)
+    c1 = np.asarray(net.state[name]["centers"])
+    assert not np.allclose(c0, c1)  # centers track features
+
+
+# ------------------------------------------------------- frozen / transfer
+def _trained_base(seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 2, (3, 6))
+    idx = rng.integers(0, 3, 256)
+    x = centers[idx] + rng.normal(0, 0.5, (256, 6))
+    y = np.eye(3)[idx]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-2)).list()
+            .layer(Dense(n_in=6, n_out=8, activation="relu", name="feat"))
+            .layer(Dense(n_out=8, activation="relu", name="mid"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent",
+                          name="out"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ArrayDataSetIterator(x, y, batch_size=64), epochs=5,
+            async_prefetch=False)
+    return net, x, y
+
+
+def test_frozen_layer_params_do_not_change():
+    net, x, y = _trained_base()
+    new_net = (TransferLearning.Builder(net)
+               .fine_tune_configuration(
+                   FineTuneConfiguration(updater=Sgd(0.5)))
+               .set_feature_extractor("mid")
+               .build())
+    w_before = np.asarray(new_net.params["feat"]["W"]).copy()
+    out_before = np.asarray(new_net.params["out"]["W"]).copy()
+    ds = DataSet(x[:64], y[:64])
+    for _ in range(5):
+        new_net.fit_batch(ds)
+    np.testing.assert_allclose(np.asarray(new_net.params["feat"]["W"]),
+                               w_before)  # frozen
+    assert not np.allclose(np.asarray(new_net.params["out"]["W"]),
+                           out_before)    # trainable
+
+
+def test_transfer_preserves_weights_and_output_replacement():
+    net, x, y = _trained_base()
+    new_net = (TransferLearning.Builder(net)
+               .set_feature_extractor("feat")
+               .remove_output_layer()
+               .add_layer(Output(n_in=8, n_out=5, activation="softmax",
+                                 loss="mcxent", name="new_out"))
+               .build())
+    # copied feature weights identical
+    np.testing.assert_allclose(np.asarray(net.params["feat"]["W"]),
+                               np.asarray(new_net.params["feat"]["W"]))
+    out = np.asarray(new_net.output(x[:4]))
+    assert out.shape == (4, 5)
+    # can train the new head
+    y5 = np.eye(5)[np.random.default_rng(0).integers(0, 5, 256)]
+    s0 = new_net.score(DataSet(x, y5))
+    for _ in range(20):
+        new_net.fit_batch(DataSet(x, y5))
+    assert new_net.score(DataSet(x, y5)) < s0
+
+
+def test_n_out_replace():
+    net, x, y = _trained_base()
+    new_net = (TransferLearning.Builder(net)
+               .n_out_replace("mid", 12)
+               .build())
+    assert new_net.params["mid"]["W"].shape == (8, 12)
+    assert new_net.params["out"]["W"].shape == (12, 3)
+    assert np.asarray(new_net.output(x[:4])).shape == (4, 3)
+
+
+def test_transfer_learning_helper_featurize():
+    net, x, y = _trained_base()
+    helper = TransferLearningHelper(net, "mid")
+    feat = helper.featurize(DataSet(x, y))
+    assert np.asarray(feat.features).shape == (256, 8)
+    tail = helper.unfrozen_net()
+    # tail on featurized input == full net on raw input
+    np.testing.assert_allclose(
+        np.asarray(tail.output(feat.features[:8])),
+        np.asarray(net.output(x[:8])), rtol=1e-6)
+    # train the tail on cached features, copy back, full net improves
+    s0 = net.score(DataSet(x, y))
+    for _ in range(10):
+        tail.fit_batch(DataSet(np.asarray(feat.features), y))
+    helper.copy_back(tail)
+    assert net.score(DataSet(x, y)) <= s0 + 1e-9
+
+
+def test_frozen_json_round_trip():
+    from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.1)).list()
+            .layer(Frozen(inner=Dense(n_in=4, n_out=3, activation="tanh"),
+                          name="f0"))
+            .layer(Output(n_in=3, n_out=2, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    restored = MultiLayerConfiguration.from_json(conf.to_json())
+    assert restored.layers[0].layer_type == "frozen"
+    assert restored.layers[0].inner.n_out == 3
+    net = MultiLayerNetwork(restored).init()
+    assert np.asarray(net.output(np.zeros((2, 4)))).shape == (2, 2)
+
+
+def test_vae_json_round_trip():
+    from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Sgd(0.1)).list()
+            .layer(VariationalAutoencoder(
+                n_in=6, n_out=2, encoder_layer_sizes=(5, 4),
+                decoder_layer_sizes=(4, 5),
+                reconstruction=GaussianReconstruction(activation="tanh")))
+            .layer(Output(n_in=2, n_out=2, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    restored = MultiLayerConfiguration.from_json(conf.to_json())
+    vae = restored.layers[0]
+    assert vae.encoder_layer_sizes == (5, 4)
+    assert vae.reconstruction.kind == "gaussian"
+    assert vae.reconstruction.activation == "tanh"
