@@ -1,0 +1,9 @@
+"""Graph embeddings (parity: deeplearning4j-graph, 2,293 LoC — SURVEY.md
+§2.7): graph API, random-walk iterators, DeepWalk."""
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import (
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk

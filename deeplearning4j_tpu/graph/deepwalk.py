@@ -1,0 +1,59 @@
+"""DeepWalk: random walks -> SkipGram with hierarchical softmax over vertex
+"words".
+
+Parity: models/deepwalk/DeepWalk.java (254 LoC; fit(IGraph, walkLength)
+:95-103 — walks feed SkipGram-style updates on a GraphHuffman tree) +
+models/embeddings/GraphVectorsImpl.java. Here the walks feed the same
+batched SequenceVectors trainer the NLP stack uses (degree-weighted Huffman
+tree replaces GraphHuffman).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import (
+    SequenceVectors,
+    SequenceVectorsConfig,
+)
+
+
+class DeepWalk:
+    def __init__(self, vector_size: int = 100, window: int = 5,
+                 walk_length: int = 40, walks_per_vertex: int = 1,
+                 learning_rate: float = 0.025, epochs: int = 1,
+                 seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.seed = seed
+        self.vectors: SequenceVectors | None = None
+
+    def fit(self, graph, walk_iterator=None):
+        """DeepWalk.fit(IGraph, walkLength) parity."""
+        if walk_iterator is None:
+            walk_iterator = RandomWalkIterator(
+                graph, self.walk_length, seed=self.seed,
+                walks_per_vertex=self.walks_per_vertex)
+        walks = [[str(v) for v in walk] for walk in walk_iterator]
+        self.vectors = SequenceVectors(SequenceVectorsConfig(
+            vector_size=self.vector_size, window=self.window,
+            min_word_frequency=1, epochs=self.epochs,
+            learning_rate=self.learning_rate, negative=0, seed=self.seed))
+        self.vectors.build_vocab(walks)
+        self.vectors.fit(walks)
+        return self
+
+    def vertex_vector(self, v: int) -> np.ndarray:
+        return self.vectors.get_word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self.vectors.similarity(str(a), str(b))
+
+    def verts_nearest(self, v: int, top_n: int = 5):
+        return [(int(w), s)
+                for w, s in self.vectors.words_nearest(str(v), top_n)]

@@ -1,0 +1,53 @@
+"""Random-walk sequence generators (parity: iterator/RandomWalkIterator.java
+and WeightedRandomWalkIterator.java in deeplearning4j-graph)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length from every vertex
+    (RandomWalkIterator.java parity; ``no_edge_handling`` SELF_LOOP keeps
+    the walker in place at sinks)."""
+
+    def __init__(self, graph, walk_length: int, seed: int = 0,
+                 walks_per_vertex: int = 1):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.walks_per_vertex = walks_per_vertex
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.walks_per_vertex):
+            for start in range(self.graph.num_vertices()):
+                walk = [start]
+                cur = start
+                for _ in range(self.walk_length - 1):
+                    nbrs = self.graph.neighbors(cur)
+                    cur = int(rng.choice(nbrs)) if nbrs else cur
+                    walk.append(cur)
+                yield walk
+
+    def reset(self):
+        pass
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional walks (WeightedRandomWalkIterator.java)."""
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.walks_per_vertex):
+            for start in range(self.graph.num_vertices()):
+                walk = [start]
+                cur = start
+                for _ in range(self.walk_length - 1):
+                    wn = self.graph.weighted_neighbors(cur)
+                    if wn:
+                        ws = np.array([w for _, w in wn], dtype=np.float64)
+                        cur = int(wn[rng.choice(len(wn),
+                                                p=ws / ws.sum())][0])
+                    walk.append(cur)
+                yield walk

@@ -1,0 +1,11 @@
+"""NLP stack (parity: deeplearning4j-nlp-parent, 36.5k LoC — SURVEY.md
+§2.6): tokenization pipeline, vocab + Huffman, batched SkipGram/CBOW/
+PV-DM/PV-DBOW/GloVe on device, word-vector serializers."""
+
+from deeplearning4j_tpu.nlp.sequence_vectors import (
+    SequenceVectors,
+    SequenceVectorsConfig,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove

@@ -1,0 +1,203 @@
+"""Batched embedding-update steps: SkipGram / CBOW with hierarchical
+softmax and negative sampling.
+
+Parity: models/embeddings/learning/impl/elements/{SkipGram, CBOW}.java —
+the reference builds native ``AggregateSkipGram`` ops executed JNI-side in
+batches (SkipGram.java:224,271-272) under Hogwild threads
+(SequenceVectors.java:1101). TPU-native design: the SAME update math
+(word2vec.c formulas), but one jitted step applies a whole batch of
+(center, target) pairs with gathers + scatter updates — deterministic and
+race-free where Hogwild is racy, and batched onto the MXU instead of
+per-pair JNI calls.
+
+Duplicate-row handling: a batch hits hot rows (Huffman roots, frequent
+words) many times, all computed at the same stale parameters; summing those
+updates multiplies the effective learning rate by the duplication count and
+diverges on small vocabularies. Updates therefore combine as a per-row MEAN
+over each batch (``_scatter_mean``) — equivalent to the sequential update in
+expectation, stable at any duplication level, and ~= the plain sum when
+duplication is low (large vocab). This is the "statistical, not bitwise"
+Hogwild equivalence called out in SURVEY.md §7.
+
+Tables: syn0 [V, D] input vectors; syn1 [V, D] HS inner-node vectors;
+syn1neg [V, D] negative-sampling output vectors. No optimizer state —
+word2vec's raw SGD, like the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _scatter_mean(table, idx, updates, weights):
+    """table[i] += mean over batch entries with idx==i of updates.
+
+    idx [N], updates [N, D], weights [N] (0 excludes an entry)."""
+    acc = jnp.zeros_like(table).at[idx].add(updates * weights[:, None])
+    cnt = jnp.zeros((table.shape[0],), table.dtype).at[idx].add(weights)
+    return table + acc / jnp.maximum(cnt, 1.0)[:, None]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
+    """Hierarchical-softmax skipgram batch.
+
+    centers [B]; points/codes/code_mask [B, L] (Huffman rows, 0/1 codes,
+    validity mask). Update per word2vec.c: g = (1 - code - sigma(h.v)) * lr.
+    """
+    h = syn0[centers]                                   # [B, D]
+    v = syn1[points]                                    # [B, L, D]
+    f = _sigmoid(jnp.einsum("bd,bld->bl", h, v))        # [B, L]
+    g = (1.0 - codes - f) * code_mask * lr              # [B, L]
+    neu1e = jnp.einsum("bl,bld->bd", g, v)              # [B, D]
+    dsyn1 = (g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+    syn1 = _scatter_mean(syn1, points.reshape(-1), dsyn1,
+                         code_mask.reshape(-1))
+    syn0 = _scatter_mean(syn0, centers, neu1e,
+                         jnp.ones_like(centers, syn0.dtype))
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_ns_step(syn0, syn1neg, centers, targets, labels, lr):
+    """Negative-sampling skipgram batch.
+
+    targets [B, 1+K] = positive context + K negatives; labels [B, 1+K] =
+    [1, 0, ..., 0]. g = (label - sigma(h.v)) * lr.
+    """
+    h = syn0[centers]
+    v = syn1neg[targets]
+    f = _sigmoid(jnp.einsum("bd,bkd->bk", h, v))
+    g = (labels - f) * lr
+    neu1e = jnp.einsum("bk,bkd->bd", g, v)
+    dneg = (g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+    syn1neg = _scatter_mean(syn1neg, targets.reshape(-1), dneg,
+                            jnp.ones(dneg.shape[0], syn0.dtype))
+    syn0 = _scatter_mean(syn0, centers, neu1e,
+                         jnp.ones_like(centers, syn0.dtype))
+    return syn0, syn1neg
+
+
+def _cbow_hidden(syn0, context, ctx_mask, extra=None):
+    ctx_vecs = syn0[context] * ctx_mask[..., None]      # [B, W, D]
+    denom = ctx_mask.sum(axis=1, keepdims=True)
+    if extra is not None:
+        denom = denom + 1.0
+        return (ctx_vecs.sum(axis=1) + extra) / jnp.maximum(denom, 1.0)
+    return ctx_vecs.sum(axis=1) / jnp.maximum(denom, 1.0)
+
+
+def _spread_to_context(syn0, context, ctx_mask, neu1e):
+    """Add each row's error to all its (unmasked) context words, averaged
+    per table row over the batch."""
+    B, W = context.shape
+    D = neu1e.shape[-1]
+    upd = jnp.broadcast_to(neu1e[:, None, :], (B, W, D)).reshape(-1, D)
+    return _scatter_mean(syn0, context.reshape(-1), upd, ctx_mask.reshape(-1))
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_step(syn0, syn1, context, ctx_mask, points, codes, code_mask, lr):
+    """CBOW with hierarchical softmax: h = mean of context vectors
+    (CBOW.java / word2vec.c cbow with mean), the error adds back to every
+    context word."""
+    h = _cbow_hidden(syn0, context, ctx_mask)
+    v = syn1[points]
+    f = _sigmoid(jnp.einsum("bd,bld->bl", h, v))
+    g = (1.0 - codes - f) * code_mask * lr
+    neu1e = jnp.einsum("bl,bld->bd", g, v)
+    dsyn1 = (g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+    syn1 = _scatter_mean(syn1, points.reshape(-1), dsyn1,
+                         code_mask.reshape(-1))
+    syn0 = _spread_to_context(syn0, context, ctx_mask, neu1e)
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_ns_step(syn0, syn1neg, context, ctx_mask, targets, labels, lr):
+    h = _cbow_hidden(syn0, context, ctx_mask)
+    v = syn1neg[targets]
+    f = _sigmoid(jnp.einsum("bd,bkd->bk", h, v))
+    g = (labels - f) * lr
+    neu1e = jnp.einsum("bk,bkd->bd", g, v)
+    dneg = (g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+    syn1neg = _scatter_mean(syn1neg, targets.reshape(-1), dneg,
+                            jnp.ones(dneg.shape[0], syn0.dtype))
+    syn0 = _spread_to_context(syn0, context, ctx_mask, neu1e)
+    return syn0, syn1neg
+
+
+# ---- paragraph-vector variants (DM.java / DBOW.java parity) ---------------
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def dm_hs_step(syn0, syn1, doc_vecs, docs, context, ctx_mask, points, codes,
+               code_mask, lr):
+    """PV-DM: h = mean(context word vectors + the doc vector); both the
+    words and the doc vector receive the error (DM.java parity)."""
+    d = doc_vecs[docs]                                   # [B, D]
+    h = _cbow_hidden(syn0, context, ctx_mask, extra=d)
+    v = syn1[points]
+    f = _sigmoid(jnp.einsum("bd,bld->bl", h, v))
+    g = (1.0 - codes - f) * code_mask * lr
+    neu1e = jnp.einsum("bl,bld->bd", g, v)
+    dsyn1 = (g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+    syn1 = _scatter_mean(syn1, points.reshape(-1), dsyn1,
+                         code_mask.reshape(-1))
+    syn0 = _spread_to_context(syn0, context, ctx_mask, neu1e)
+    doc_vecs = _scatter_mean(doc_vecs, docs, neu1e,
+                             jnp.ones_like(docs, syn0.dtype))
+    return syn0, syn1, doc_vecs
+
+
+def _dbow_core(syn1, doc_vecs, docs, points, codes, code_mask, lr,
+               update_syn1):
+    h = doc_vecs[docs]
+    v = syn1[points]
+    f = _sigmoid(jnp.einsum("bd,bld->bl", h, v))
+    g = (1.0 - codes - f) * code_mask * lr
+    neu1e = jnp.einsum("bl,bld->bd", g, v)
+    if update_syn1:
+        dsyn1 = (g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+        syn1 = _scatter_mean(syn1, points.reshape(-1), dsyn1,
+                             code_mask.reshape(-1))
+    doc_vecs = _scatter_mean(doc_vecs, docs, neu1e,
+                             jnp.ones_like(docs, doc_vecs.dtype))
+    return syn1, doc_vecs
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def dbow_hs_step(syn1, doc_vecs, docs, points, codes, code_mask, lr):
+    """PV-DBOW: the doc vector predicts each word (DBOW.java parity) —
+    skipgram with the doc vector as the center; word syn0 is untouched."""
+    return _dbow_core(syn1, doc_vecs, docs, points, codes, code_mask, lr,
+                      update_syn1=True)
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def dbow_hs_step_frozen(syn1, doc_vecs, docs, points, codes, code_mask, lr):
+    """DBOW inference variant: syn1 frozen, only doc vectors update
+    (ParagraphVectors.inferVector parity)."""
+    _, doc_vecs = _dbow_core(syn1, doc_vecs, docs, points, codes, code_mask,
+                             lr, update_syn1=False)
+    return doc_vecs
+
+
+@partial(jax.jit, donate_argnums=(2,))
+def dm_hs_step_frozen(syn0, syn1, doc_vecs, docs, context, ctx_mask, points,
+                      codes, code_mask, lr):
+    """DM inference variant: word tables frozen, only doc vectors update."""
+    d = doc_vecs[docs]
+    h = _cbow_hidden(syn0, context, ctx_mask, extra=d)
+    v = syn1[points]
+    f = _sigmoid(jnp.einsum("bd,bld->bl", h, v))
+    g = (1.0 - codes - f) * code_mask * lr
+    neu1e = jnp.einsum("bl,bld->bd", g, v)
+    return _scatter_mean(doc_vecs, docs, neu1e,
+                         jnp.ones_like(docs, doc_vecs.dtype))

@@ -1,0 +1,137 @@
+"""GloVe: co-occurrence counting + batched AdaGrad factorization.
+
+Parity: models/glove/Glove.java (429 LoC) + models/embeddings/learning/
+impl/elements/GloVe.java (406 LoC) + models/glove/count/ (co-occurrence
+counting). Host counts co-occurrences into COO arrays; the device runs the
+classic GloVe objective J = f(X_ij)(w_i·w~_j + b_i + b~_j - log X_ij)^2
+with per-parameter AdaGrad, one jitted step per shuffled batch.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from functools import partial
+from typing import Iterable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
+    """One AdaGrad batch: w/wc word+context vectors, b/bc biases, h* the
+    AdaGrad accumulators."""
+    wi = w[rows]
+    wj = wc[cols]
+    diff = jnp.sum(wi * wj, axis=1) + b[rows] + bc[cols] - logx
+    fdiff = fx * diff                                    # [B]
+    # gradients
+    gw = fdiff[:, None] * wj
+    gwc = fdiff[:, None] * wi
+    gb = fdiff
+    gbc = fdiff
+    # AdaGrad scatter updates
+    hw = hw.at[rows].add(gw * gw)
+    hwc = hwc.at[cols].add(gwc * gwc)
+    hb = hb.at[rows].add(gb * gb)
+    hbc = hbc.at[cols].add(gbc * gbc)
+    w = w.at[rows].add(-lr * gw / jnp.sqrt(hw[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * gwc / jnp.sqrt(hwc[cols] + 1e-8))
+    b = b.at[rows].add(-lr * gb / jnp.sqrt(hb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * gbc / jnp.sqrt(hbc[cols] + 1e-8))
+    return w, wc, b, bc, hw, hwc, hb, hbc
+
+
+class Glove:
+    def __init__(self, vector_size: int = 100, window: int = 15,
+                 min_word_frequency: int = 1, epochs: int = 25,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 4096,
+                 symmetric: bool = True, seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.seed = seed
+        self.vocab = None
+        self.lookup = None
+
+    def fit(self, sequences: Iterable[List[str]]):
+        self.vocab = VocabConstructor(self.min_word_frequency).build(sequences)
+        V, D = len(self.vocab), self.vector_size
+        rng = np.random.default_rng(self.seed)
+
+        # ---- co-occurrence counting (models/glove/count parity) ----------
+        cooc = defaultdict(float)
+        for tokens in sequences:
+            idxs = [self.vocab.index_of(t) for t in tokens]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, wi in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(idxs):
+                        break
+                    wj = idxs[j]
+                    weight = 1.0 / off  # distance weighting (GloVe paper)
+                    cooc[(wi, wj)] += weight
+                    if self.symmetric:
+                        cooc[(wj, wi)] += weight
+        if not cooc:
+            raise ValueError("Empty co-occurrence matrix")
+        rows = np.fromiter((k[0] for k in cooc), np.int32, len(cooc))
+        cols = np.fromiter((k[1] for k in cooc), np.int32, len(cooc))
+        xs = np.fromiter(cooc.values(), np.float32, len(cooc))
+        logx = np.log(xs)
+        fx = np.minimum((xs / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+
+        # ---- tables + AdaGrad state -------------------------------------
+        def init(shape):
+            return jnp.asarray((rng.random(shape) - 0.5) / D, jnp.float32)
+        w, wc = init((V, D)), init((V, D))
+        b, bc = jnp.zeros((V,), jnp.float32), jnp.zeros((V,), jnp.float32)
+        hw, hwc = jnp.ones((V, D), jnp.float32), jnp.ones((V, D), jnp.float32)
+        hb, hbc = jnp.ones((V,), jnp.float32), jnp.ones((V,), jnp.float32)
+
+        n = len(xs)
+        bs = self.batch_size
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - bs + 1, bs):
+                sl = perm[s:s + bs]
+                w, wc, b, bc, hw, hwc, hb, hbc = _glove_step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
+                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]),
+                    self.learning_rate)
+            if n % bs:
+                sl = perm[n - (n % bs):]
+                w, wc, b, bc, hw, hwc, hb, hbc = _glove_step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
+                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]),
+                    self.learning_rate)
+
+        # final vectors = w + wc (GloVe paper / reference convention)
+        self.lookup = InMemoryLookupTable(self.vocab, D, seed=self.seed,
+                                          use_hs=True, negative=0)
+        self.lookup.syn0 = w + wc
+        self.lookup.syn1 = None
+        return self
+
+    def similarity(self, a, b):
+        return self.lookup.similarity(a, b)
+
+    def words_nearest(self, word, top_n: int = 10):
+        return self.lookup.words_nearest(word, top_n)
+
+    def get_word_vector(self, word):
+        return self.lookup.vector(word)

@@ -1,0 +1,95 @@
+"""In-memory embedding lookup table + nearest-neighbor queries.
+
+Parity: models/embeddings/inmemory/InMemoryLookupTable.java (731 LoC:
+syn0/syn1/syn1neg + negative table) and wordvectors.WordVectors query API
+(similarity, wordsNearest). Tables are jnp arrays; similarity queries run
+as one device matmul against the normalized table.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, make_negative_table
+
+
+class InMemoryLookupTable:
+    def __init__(self, cache: VocabCache, vector_size: int, seed: int = 42,
+                 use_hs: bool = True, negative: int = 0,
+                 negative_table_size: int = 1_000_000):
+        self.cache = cache
+        self.vector_size = vector_size
+        V = len(cache)
+        rng = np.random.default_rng(seed)
+        # word2vec init: syn0 ~ U(-0.5/D, 0.5/D), syn1 zeros
+        self.syn0 = jnp.asarray(
+            (rng.random((V, vector_size)) - 0.5) / vector_size,
+            dtype=jnp.float32)
+        self.syn1 = (jnp.zeros((V, vector_size), jnp.float32)
+                     if use_hs else None)
+        self.syn1neg = (jnp.zeros((V, vector_size), jnp.float32)
+                        if negative > 0 else None)
+        self.negative = negative
+        self.neg_table = (make_negative_table(cache, negative_table_size)
+                          if negative > 0 else None)
+
+    # ------------------------------------------------------------- queries
+    def vector(self, word: str) -> np.ndarray:
+        idx = self.cache.index_of(word)
+        if idx < 0:
+            raise KeyError(f"Word '{word}' not in vocabulary")
+        return np.asarray(self.syn0[idx])
+
+    def _normed(self) -> jnp.ndarray:
+        norms = jnp.linalg.norm(self.syn0, axis=1, keepdims=True)
+        return self.syn0 / jnp.maximum(norms, 1e-12)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.vector(a), self.vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / max(denom, 1e-12))
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[Tuple[str, float]]:
+        if isinstance(word_or_vec, str):
+            v = self.vector(word_or_vec)
+            exclude = {self.cache.index_of(word_or_vec)}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        v = v / max(np.linalg.norm(v), 1e-12)
+        sims = np.asarray(self._normed() @ jnp.asarray(v, jnp.float32))
+        order = np.argsort(-sims)
+        out = []
+        for idx in order:
+            if int(idx) in exclude:
+                continue
+            out.append((self.cache.word_for_index(int(idx)),
+                        float(sims[idx])))
+            if len(out) >= top_n:
+                break
+        return out
+
+    def words_nearest_sum(self, positive: Sequence[str],
+                          negative: Sequence[str] = (), top_n: int = 10):
+        """king - man + woman style analogy queries
+        (WordVectorsImpl.wordsNearestSum parity)."""
+        v = np.zeros(self.vector_size, dtype=np.float64)
+        for w in positive:
+            v += self.vector(w)
+        for w in negative:
+            v -= self.vector(w)
+        exclude = {self.cache.index_of(w) for w in (*positive, *negative)}
+        v = v / max(np.linalg.norm(v), 1e-12)
+        sims = np.asarray(self._normed() @ jnp.asarray(v, jnp.float32))
+        order = np.argsort(-sims)
+        out = []
+        for idx in order:
+            if int(idx) in exclude:
+                continue
+            out.append((self.cache.word_for_index(int(idx)), float(sims[idx])))
+            if len(out) >= top_n:
+                break
+        return out

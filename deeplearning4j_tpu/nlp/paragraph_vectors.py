@@ -1,0 +1,164 @@
+"""ParagraphVectors (doc2vec): PV-DM and PV-DBOW + inferVector.
+
+Parity: models/paragraphvectors/ParagraphVectors.java (1,436 LoC) with
+learning algorithms embeddings/learning/impl/sequence/{DM, DBOW}.java.
+Documents are (label, text) pairs; label vectors live in their own table.
+``infer_vector`` trains a fresh doc vector against FROZEN word tables —
+exactly the reference's inference path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import elements
+from deeplearning4j_tpu.nlp.sequence_vectors import (
+    SequenceVectors,
+    SequenceVectorsConfig,
+)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, config: SequenceVectorsConfig | None = None,
+                 sequence_algorithm: str = "dbow", **kw):
+        """sequence_algorithm: 'dbow' (PV-DBOW) or 'dm' (PV-DM)."""
+        super().__init__(config, **kw)
+        if not self.config.use_hs:
+            raise ValueError("ParagraphVectors here uses hierarchical "
+                             "softmax (negative-sampling variant TBD); "
+                             "leave negative=0")
+        self.sequence_algorithm = sequence_algorithm
+        self.doc_labels: List[str] = []
+        self.doc_vecs = None
+
+    # ------------------------------------------------------------- training
+    def fit_documents(self, documents, tokenizer_factory=None):
+        """documents: LabelAwareIterator / iterable of (label, text)."""
+        tf = tokenizer_factory or DefaultTokenizerFactory()
+        labels, token_seqs = [], []
+        for label, text in documents:
+            tokens = tf.create(text).get_tokens()
+            if tokens:
+                labels.append(label)
+                token_seqs.append(tokens)
+        self.doc_labels = labels
+        self.build_vocab(token_seqs)
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed + 1)
+        self.doc_vecs = jnp.asarray(
+            (rng.random((len(labels), cfg.vector_size)) - 0.5)
+            / cfg.vector_size, dtype=jnp.float32)
+
+        seqs = self._sequences_to_indices(token_seqs)
+        total = sum(len(s) for s in seqs) * cfg.epochs
+        seen = 0
+        for _ in range(cfg.epochs):
+            for di in self._rng.permutation(len(seqs)):
+                seq = self._subsample(seqs[di])
+                if len(seq) < 1:
+                    seen += len(seqs[di])
+                    continue
+                lr = max(cfg.min_learning_rate,
+                         cfg.learning_rate * (1 - seen / max(total, 1)))
+                self._train_doc(int(di), seq, lr, frozen_words=False)
+                seen += len(seqs[di])
+        return self
+
+    def _train_doc(self, doc_idx, seq, lr, *, frozen_words, doc_vecs=None,
+                   table=None):
+        """One pass of DM/DBOW updates for one document."""
+        cfg = self.config
+        lk = self.lookup
+        dv = self.doc_vecs if doc_vecs is None else doc_vecs
+        if self.sequence_algorithm == "dbow":
+            targets = np.asarray(seq, np.int32)
+            docs = np.full(len(targets), doc_idx, np.int32)
+            points, codes, mask = self._hs_arrays(targets)
+            if frozen_words:
+                dv = elements.dbow_hs_step_frozen(
+                    lk.syn1, dv, jnp.asarray(docs), points, codes, mask, lr)
+            else:
+                lk.syn1, dv = elements.dbow_hs_step(
+                    lk.syn1, dv, jnp.asarray(docs), points, codes, mask, lr)
+        else:  # dm
+            n = len(seq)
+            rows = []
+            bs = self._rng.integers(1, cfg.window + 1, size=n)
+            for pos in range(n):
+                b = bs[pos]
+                ctx = [seq[j] for j in range(max(0, pos - b),
+                                             min(n, pos + b + 1)) if j != pos]
+                if ctx:
+                    rows.append((ctx, seq[pos]))
+            if not rows:
+                return dv
+            W = max(len(c) for c, _ in rows)
+            ctx_arr = np.zeros((len(rows), W), np.int32)
+            ctx_mask = np.zeros((len(rows), W), np.float32)
+            targets = np.empty(len(rows), np.int32)
+            for i, (c, t) in enumerate(rows):
+                ctx_arr[i, :len(c)] = c
+                ctx_mask[i, :len(c)] = 1.0
+                targets[i] = t
+            docs = np.full(len(rows), doc_idx, np.int32)
+            points, codes, mask = self._hs_arrays(targets)
+            if frozen_words:
+                dv = elements.dm_hs_step_frozen(
+                    lk.syn0, lk.syn1, dv, jnp.asarray(docs),
+                    jnp.asarray(ctx_arr), jnp.asarray(ctx_mask), points,
+                    codes, mask, lr)
+            else:
+                lk.syn0, lk.syn1, dv = elements.dm_hs_step(
+                    lk.syn0, lk.syn1, dv, jnp.asarray(docs),
+                    jnp.asarray(ctx_arr), jnp.asarray(ctx_mask), points,
+                    codes, mask, lr)
+        if doc_vecs is None:
+            self.doc_vecs = dv
+        return dv
+
+    # ------------------------------------------------------------ inference
+    def infer_vector(self, text: str, tokenizer_factory=None,
+                     iterations: int = 10, lr: float = 0.025) -> np.ndarray:
+        """Train a fresh doc vector for unseen text with word tables frozen
+        (ParagraphVectors.inferVector parity)."""
+        tf = tokenizer_factory or DefaultTokenizerFactory()
+        tokens = tf.create(text).get_tokens()
+        seq = np.asarray([self.vocab.index_of(t) for t in tokens
+                          if self.vocab.index_of(t) >= 0], np.int32)
+        rng = np.random.default_rng(0)
+        dv = jnp.asarray((rng.random((1, self.config.vector_size)) - 0.5)
+                         / self.config.vector_size, dtype=jnp.float32)
+        if len(seq) == 0:
+            return np.asarray(dv[0])
+        for i in range(iterations):
+            step_lr = lr * (1 - i / iterations) + 1e-4
+            dv = self._train_doc(0, seq, step_lr, frozen_words=True,
+                                 doc_vecs=dv)
+        return np.asarray(dv[0])
+
+    # -------------------------------------------------------------- queries
+    def doc_vector(self, label: str) -> np.ndarray:
+        return np.asarray(self.doc_vecs[self.doc_labels.index(label)])
+
+    def similarity_doc(self, a: str, b: str) -> float:
+        va, vb = self.doc_vector(a), self.doc_vector(b)
+        return float(va @ vb / max(np.linalg.norm(va) * np.linalg.norm(vb),
+                                   1e-12))
+
+    def nearest_labels(self, vec_or_label, top_n: int = 5):
+        if isinstance(vec_or_label, str):
+            v = self.doc_vector(vec_or_label)
+            exclude = {self.doc_labels.index(vec_or_label)}
+        else:
+            v, exclude = np.asarray(vec_or_label), set()
+        dvs = np.asarray(self.doc_vecs)
+        dvs = dvs / np.maximum(np.linalg.norm(dvs, axis=1, keepdims=True),
+                               1e-12)
+        sims = dvs @ (v / max(np.linalg.norm(v), 1e-12))
+        order = np.argsort(-sims)
+        return [(self.doc_labels[i], float(sims[i]))
+                for i in order if i not in exclude][:top_n]

@@ -1,0 +1,161 @@
+"""Tokenization / corpus pipeline.
+
+Parity: deeplearning4j-nlp text/tokenization/ (TokenizerFactory ->
+Tokenizer -> TokenPreProcess), text/sentenceiterator/ and
+text/documentiterator/ (SURVEY.md §2.6). The pipeline shape is identical:
+SentenceIterator -> TokenizerFactory.create(sentence) -> tokens ->
+preprocessor per token. All host-side (CPU) code — tokenization never
+touches the device.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# Token preprocessors (text/tokenization/tokenizer/preprocessor/ parity)
+# ---------------------------------------------------------------------------
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation (CommonPreprocessor.java parity)."""
+
+    _PUNCT = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class LowCasePreprocessor:
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class EndingPreProcessor:
+    """Crude stemmer (EndingPreProcessor.java parity: strips s/ed/ing/ly)."""
+
+    def pre_process(self, token: str) -> str:
+        for suffix in ("ing", "ed", "ly", "s"):
+            if token.endswith(suffix) and len(token) > len(suffix) + 2:
+                return token[: -len(suffix)]
+        return token
+
+
+# ---------------------------------------------------------------------------
+# Tokenizers (text/tokenization/tokenizerfactory/ parity)
+# ---------------------------------------------------------------------------
+
+class DefaultTokenizer:
+    """Whitespace tokenizer (DefaultTokenizer.java parity)."""
+
+    def __init__(self, text: str, preprocessor=None):
+        self._tokens = text.split()
+        self._pre = preprocessor
+
+    def get_tokens(self) -> List[str]:
+        out = []
+        for t in self._tokens:
+            if self._pre is not None:
+                t = self._pre.pre_process(t)
+            if t:
+                out.append(t)
+        return out
+
+
+class DefaultTokenizerFactory:
+    def __init__(self):
+        self._pre = None
+
+    def set_token_pre_processor(self, pre):
+        self._pre = pre
+        return self
+
+    def create(self, text: str) -> DefaultTokenizer:
+        return DefaultTokenizer(text, self._pre)
+
+
+class NGramTokenizerFactory(DefaultTokenizerFactory):
+    """Emits n-grams joined by '_' (NGramTokenizerFactory.java parity)."""
+
+    def __init__(self, n_min: int = 1, n_max: int = 2):
+        super().__init__()
+        self.n_min, self.n_max = n_min, n_max
+
+    def create(self, text: str):
+        base = DefaultTokenizer(text, self._pre).get_tokens()
+        grams = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(base) - n + 1):
+                grams.append("_".join(base[i:i + n]))
+
+        class _T:
+            def get_tokens(self_inner):
+                return grams
+        return _T()
+
+
+# ---------------------------------------------------------------------------
+# Sentence iterators (text/sentenceiterator/ parity)
+# ---------------------------------------------------------------------------
+
+class CollectionSentenceIterator:
+    """Iterate over an in-memory list of sentences
+    (CollectionSentenceIterator.java parity)."""
+
+    def __init__(self, sentences: Iterable[str]):
+        self._sentences = list(sentences)
+        self._pre: Optional[Callable[[str], str]] = None
+
+    def set_pre_processor(self, fn: Callable[[str], str]):
+        self._pre = fn
+        return self
+
+    def __iter__(self):
+        for s in self._sentences:
+            yield self._pre(s) if self._pre else s
+
+    def reset(self):
+        pass
+
+
+class BasicLineIterator:
+    """One sentence per line from a file (BasicLineIterator.java parity)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pre = None
+
+    def set_pre_processor(self, fn):
+        self._pre = fn
+        return self
+
+    def __iter__(self):
+        with open(self.path, "r", encoding="utf-8", errors="ignore") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield self._pre(line) if self._pre else line
+
+    def reset(self):
+        pass
+
+
+class LabelAwareIterator:
+    """(label, text) document pairs for ParagraphVectors
+    (text/documentiterator/LabelAwareIterator.java parity)."""
+
+    def __init__(self, documents: Iterable):
+        """documents: iterable of (label, text) or dict {label: text}."""
+        if isinstance(documents, dict):
+            documents = list(documents.items())
+        self._docs = list(documents)
+
+    def __iter__(self):
+        return iter(self._docs)
+
+    def labels(self):
+        return [l for l, _ in self._docs]
+
+    def reset(self):
+        pass

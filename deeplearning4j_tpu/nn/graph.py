@@ -235,7 +235,8 @@ class ComputationGraph:
                                train=train, rng=this_rng, mask=lm, state=s_out)
                 if train and hasattr(layer, "update_centers"):
                     new_state[name] = layer.update_centers(
-                        s_out, jax.lax.stop_gradient(xs[0]), labels[i])
+                        s_out, jax.lax.stop_gradient(xs[0]), labels[i],
+                        mask=lm)
             else:
                 l = layer.loss(params.get(name, {}), xs[0], labels[i],
                                train=train, rng=this_rng, mask=lm)

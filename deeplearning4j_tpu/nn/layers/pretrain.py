@@ -107,14 +107,21 @@ class CenterLossOutputLayer(OutputLayer):
         if centers is None:
             return base
         c_y = labels @ centers  # one-hot selects each example's class center
-        center_term = 0.5 * self.conf.lmbda * jnp.mean(
-            jnp.sum((x - c_y) ** 2, axis=-1))
+        sq = jnp.sum((x - c_y) ** 2, axis=-1)
+        if mask is not None:
+            m = mask.reshape(-1).astype(sq.dtype)
+            center_term = 0.5 * self.conf.lmbda * (
+                jnp.sum(sq * m) / jnp.maximum(jnp.sum(m), 1.0))
+        else:
+            center_term = 0.5 * self.conf.lmbda * jnp.mean(sq)
         return base + center_term
 
-    def update_centers(self, state, x, labels):
+    def update_centers(self, state, x, labels, mask=None):
         """alpha moving-average center update (applied in the train step,
-        outside the differentiated loss)."""
+        outside the differentiated loss); masked examples are excluded."""
         centers = state["centers"]
+        if mask is not None:
+            labels = labels * mask.reshape(-1, 1).astype(labels.dtype)
         counts = jnp.maximum(labels.sum(axis=0), 1.0)[:, None]
         sums = labels.T @ x
         batch_means = sums / counts

@@ -221,7 +221,7 @@ class MultiLayerNetwork:
                                        rng=lrng, mask=lmask, state=s_out)
             if train and hasattr(out_layer, "update_centers"):
                 new_state[out_layer.name] = out_layer.update_centers(
-                    s_out, jax.lax.stop_gradient(h), labels)
+                    s_out, jax.lax.stop_gradient(h), labels, mask=lmask)
         else:
             data_loss = out_layer.loss(p_out, h, labels, train=train,
                                        rng=lrng, mask=lmask)
@@ -438,6 +438,7 @@ class MultiLayerNetwork:
         params_sub = {name: self.params[name]}
         opt_sub = {name: self.opt_state[name]}
         last = None
+        iteration = self.iteration
         for _ in range(epochs):
             for ds in iterator:
                 x = jnp.asarray(ds.features)
@@ -448,10 +449,12 @@ class MultiLayerNetwork:
                 if self.preprocessors[idx] is not None:
                     x = self.preprocessors[idx](x)
                 self._rng_key, rng = jax.random.split(self._rng_key)
-                itc = jnp.asarray(self.iteration, jnp.int32)
+                itc = jnp.asarray(iteration, jnp.int32)
                 params_sub, opt_sub, last = jitted(params_sub, opt_sub, itc,
                                                    x, rng)
+                iteration += 1
             iterator.reset()
+        self.iteration = iteration
         self.params = {**self.params, name: params_sub[name]}
         self.opt_state = {**self.opt_state, name: opt_sub[name]}
         self.score_value = last

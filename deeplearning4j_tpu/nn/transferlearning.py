@@ -109,12 +109,10 @@ class TransferLearningBuilder:
         if self._fine_tune is not None:
             gc = self._fine_tune.apply_to(gc)
         confs = []
-        carry = []
         for i, (conf, keep) in enumerate(self._layers):
             if self._freeze_until is not None and i <= self._freeze_until:
                 conf = Frozen(inner=conf, name=conf.name)
             confs.append(conf)
-            carry.append(keep)
         new_conf = MultiLayerConfiguration(
             global_conf=gc,
             layers=tuple(confs),
@@ -165,11 +163,20 @@ class TransferLearningHelper:
 
     def unfrozen_net(self) -> MultiLayerNetwork:
         """A tail network (layers after the frozen boundary) sharing this
-        net's configs, with weights copied in."""
-        confs = self.net._resolved_confs[self.frozen_until + 1:]
+        net's configs, with weights copied in. The boundary layer's
+        preprocessor (explicit or auto-inserted) moves into the tail so
+        featurized activations feed it exactly as in the full net."""
+        start = self.frozen_until + 1
+        confs = self.net._resolved_confs[start:]
+        preprocessors = {
+            i - start: p
+            for i, p in enumerate(self.net.preprocessors)
+            if i >= start and p is not None
+        }
         tail_conf = MultiLayerConfiguration(
             global_conf=self.net.conf.global_conf,
             layers=tuple(confs),
+            preprocessors=preprocessors,
         )
         tail = MultiLayerNetwork(tail_conf).init()
         for c in confs:

@@ -96,7 +96,9 @@ class LineGradientDescent(BaseSolver):
         fx = float(fx)
         for i in range(self.max_iterations):
             a, fnew, d = backtrack_line_search(loss, flat, fx, g, -g)
-            if a == 0.0 or abs(fx - fnew) < self.tolerance:
+            if a == 0.0:
+                return flat, i + 1, False  # line search stalled
+            if abs(fx - fnew) < self.tolerance:
                 return flat, i + 1, True
             flat = flat + a * d
             fx, g = vg(flat)
@@ -114,7 +116,9 @@ class ConjugateGradient(BaseSolver):
         d = -g
         for i in range(self.max_iterations):
             a, fnew, d = backtrack_line_search(loss, flat, fx, g, d)
-            if a == 0.0 or abs(fx - fnew) < self.tolerance:
+            if a == 0.0:
+                return flat, i + 1, False  # line search stalled
+            if abs(fx - fnew) < self.tolerance:
                 return flat, i + 1, True
             flat = flat + a * d
             fx_new, g_new = vg(flat)
@@ -157,7 +161,9 @@ class LBFGS(BaseSolver):
             d = jnp.asarray(-q, dtype=flat.dtype)
 
             a, fnew, d = backtrack_line_search(loss, flat, fx, g, d)
-            if a == 0.0 or abs(fx - fnew) < self.tolerance:
+            if a == 0.0:
+                return flat, i + 1, False  # line search stalled
+            if abs(fx - fnew) < self.tolerance:
                 return flat, i + 1, True
             new_flat = flat + a * d
             fx_new, g_new = vg(new_flat)

@@ -1,0 +1,224 @@
+"""NLP stack tests mirroring the reference's strategy (train tiny corpora
+and assert nearest-neighbor sanity — deeplearning4j-nlp tests analogue),
+plus unit tests for Huffman coding, negative-sampling tables, tokenizers,
+serializer round-trips, and DeepWalk on a two-cluster graph."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabConstructor,
+    build_huffman,
+    make_negative_table,
+)
+from deeplearning4j_tpu.nlp.sequence_vectors import (
+    SequenceVectors,
+    SequenceVectorsConfig,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+
+
+def topic_corpus(n_sentences=300, seed=0):
+    """Synthetic corpus with two topics: words of a topic co-occur, so
+    same-topic words must embed closer than cross-topic words."""
+    rng = np.random.default_rng(seed)
+    topics = [
+        ["cat", "dog", "pet", "fur", "paw", "tail", "meow", "bark"],
+        ["car", "road", "wheel", "engine", "drive", "fuel", "brake", "gear"],
+    ]
+    sentences = []
+    for _ in range(n_sentences):
+        t = topics[rng.integers(0, 2)]
+        words = rng.choice(t, size=6, replace=True)
+        sentences.append(" ".join(words))
+    return sentences
+
+
+def assert_topic_structure(model):
+    """Same-topic similarity must exceed cross-topic similarity."""
+    same = np.mean([model.similarity("cat", "dog"),
+                    model.similarity("car", "road"),
+                    model.similarity("pet", "fur"),
+                    model.similarity("engine", "wheel")])
+    cross = np.mean([model.similarity("cat", "car"),
+                     model.similarity("dog", "road"),
+                     model.similarity("pet", "engine"),
+                     model.similarity("fur", "wheel")])
+    assert same > cross + 0.2, (same, cross)
+
+
+# ---------------------------------------------------------------- units
+def test_tokenizers():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    assert tf.create("Hello, World! 123").get_tokens() == ["hello", "world"]
+    ng = NGramTokenizerFactory(1, 2)
+    toks = ng.create("a b c").get_tokens()
+    assert "a_b" in toks and "b_c" in toks and "a" in toks
+
+
+def test_vocab_and_huffman():
+    seqs = [["the"] * 50 + ["cat"] * 10 + ["rare"] * 2]
+    cache = VocabConstructor(min_word_frequency=1).build(seqs)
+    assert cache.index_of("the") == 0  # most frequent first
+    the, rare = cache.words["the"], cache.words["rare"]
+    # Huffman: frequent words get shorter codes
+    assert len(the.code) <= len(rare.code)
+    # codes are prefix-free
+    codes = ["".join(map(str, w.code)) for w in cache.vocab_words]
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not b.startswith(a)
+    # points index syn1 rows (inner nodes: 0..V-2)
+    for w in cache.vocab_words:
+        assert all(0 <= p < len(cache) - 1 for p in w.points)
+
+
+def test_min_word_frequency_prunes():
+    seqs = [["a", "a", "a", "b"]]
+    cache = VocabConstructor(min_word_frequency=2).build(seqs)
+    assert "a" in cache and "b" not in cache
+
+
+def test_negative_table_distribution():
+    seqs = [["common"] * 75 + ["rare"] * 1]
+    cache = VocabConstructor(1).build(seqs)
+    table = make_negative_table(cache, table_size=10000)
+    frac_common = np.mean(table == cache.index_of("common"))
+    # unigram^0.75: 75^.75/(75^.75+1) ~ 0.962
+    assert 0.93 < frac_common < 0.99
+
+
+# ------------------------------------------------------------- word2vec
+def test_word2vec_hierarchical_softmax_learns_topics():
+    w2v = Word2Vec(vector_size=32, window=4, negative=0, epochs=12,
+                   learning_rate=0.05, seed=1)
+    w2v.fit_sentences(CollectionSentenceIterator(topic_corpus()),
+                      DefaultTokenizerFactory())
+    assert_topic_structure(w2v)
+    # wordsNearest returns same-topic words first
+    nearest = [w for w, _ in w2v.words_nearest("cat", top_n=3)]
+    topic1 = {"dog", "pet", "fur", "paw", "tail", "meow", "bark"}
+    assert len(set(nearest) & topic1) >= 2, nearest
+
+
+def test_word2vec_negative_sampling_learns_topics():
+    w2v = Word2Vec(vector_size=32, window=4, negative=5, epochs=20,
+                   learning_rate=0.1, batch_size=128, seed=2)
+    w2v.fit_sentences(CollectionSentenceIterator(topic_corpus(seed=3)))
+    assert_topic_structure(w2v)
+
+
+def test_cbow_learns_topics():
+    w2v = Word2Vec(vector_size=32, window=4, negative=0, epochs=20,
+                   learning_rate=0.1, algorithm="cbow", batch_size=128,
+                   seed=4)
+    w2v.fit_sentences(CollectionSentenceIterator(topic_corpus(seed=5)))
+    assert_topic_structure(w2v)
+
+
+# ------------------------------------------------------- paragraph vectors
+def topic_documents(n_docs=60, seed=0):
+    rng = np.random.default_rng(seed)
+    topics = [
+        ["cat", "dog", "pet", "fur", "paw", "tail", "meow", "bark"],
+        ["car", "road", "wheel", "engine", "drive", "fuel", "brake", "gear"],
+    ]
+    docs = []
+    for i in range(n_docs):
+        t = i % 2
+        words = rng.choice(topics[t], size=20, replace=True)
+        docs.append((f"doc_{t}_{i}", " ".join(words)))
+    return docs
+
+
+@pytest.mark.parametrize("algo", ["dbow", "dm"])
+def test_paragraph_vectors_doc_similarity(algo):
+    pv = ParagraphVectors(vector_size=24, window=4, epochs=20,
+                          learning_rate=0.05, seed=1,
+                          sequence_algorithm=algo)
+    pv.fit_documents(topic_documents())
+    same = pv.similarity_doc("doc_0_0", "doc_0_2")
+    cross = pv.similarity_doc("doc_0_0", "doc_1_1")
+    assert same > cross, (algo, same, cross)
+
+
+def test_infer_vector_lands_near_own_topic():
+    pv = ParagraphVectors(vector_size=24, window=4, epochs=25,
+                          learning_rate=0.05, seed=1)
+    pv.fit_documents(topic_documents())
+    vec = pv.infer_vector("cat dog pet fur meow paw dog cat pet fur",
+                          iterations=20)
+    nearest = [l for l, _ in pv.nearest_labels(vec, top_n=6)]
+    topic0 = sum(1 for l in nearest if l.startswith("doc_0"))
+    assert topic0 >= 4, nearest
+
+
+# ----------------------------------------------------------------- glove
+def test_glove_learns_topics():
+    corpus = topic_corpus(seed=7)
+    tf = DefaultTokenizerFactory()
+    seqs = [tf.create(s).get_tokens() for s in corpus]
+    glove = Glove(vector_size=24, window=4, epochs=30, learning_rate=0.05,
+                  batch_size=64, seed=1)
+    glove.fit(seqs)
+    assert_topic_structure(glove)
+
+
+# ------------------------------------------------------------ serializers
+def test_word_vector_serializer_round_trips(tmp_path):
+    from deeplearning4j_tpu.nlp.serializers import (
+        read_word2vec_binary,
+        read_word_vectors,
+        write_word2vec_binary,
+        write_word_vectors,
+    )
+    w2v = Word2Vec(vector_size=8, window=3, negative=0, epochs=2, seed=1)
+    w2v.fit_sentences(CollectionSentenceIterator(topic_corpus()[:40]))
+
+    txt = str(tmp_path / "vecs.txt")
+    write_word_vectors(w2v.lookup, txt)
+    restored = read_word_vectors(txt)
+    for w in ["cat", "car"]:
+        np.testing.assert_allclose(restored.vector(w), w2v.lookup.vector(w),
+                                   atol=1e-5)
+
+    binp = str(tmp_path / "vecs.bin")
+    write_word2vec_binary(w2v.lookup, binp)
+    restored_b = read_word2vec_binary(binp)
+    for w in ["cat", "car"]:
+        np.testing.assert_allclose(restored_b.vector(w),
+                                   w2v.lookup.vector(w), atol=1e-6)
+
+
+# -------------------------------------------------------------- deepwalk
+def test_deepwalk_two_cliques():
+    from deeplearning4j_tpu.graph import DeepWalk, Graph
+
+    edges = []
+    for i in range(6):          # clique A: 0-5
+        for j in range(i + 1, 6):
+            edges.append((i, j))
+    for i in range(6, 12):      # clique B: 6-11
+        for j in range(i + 1, 12):
+            edges.append((i, j))
+    edges.append((5, 6))        # bridge
+    g = Graph.from_edge_list(edges)
+
+    dw = DeepWalk(vector_size=16, window=4, walk_length=20,
+                  walks_per_vertex=8, epochs=5, seed=3)
+    dw.fit(g)
+    same = np.mean([dw.similarity(0, 1), dw.similarity(2, 3),
+                    dw.similarity(7, 8), dw.similarity(9, 10)])
+    cross = np.mean([dw.similarity(0, 11), dw.similarity(1, 9),
+                     dw.similarity(3, 8), dw.similarity(2, 10)])
+    assert same > cross + 0.1, (same, cross)
