@@ -1,0 +1,5 @@
+"""Clustering suite (parity: deeplearning4j-core clustering/ — kmeans +
+spatial trees; SURVEY.md §2.5)."""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.trees import KDTree, VPTree

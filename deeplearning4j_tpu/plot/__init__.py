@@ -1,0 +1,4 @@
+"""Dimensionality-reduction / plotting utilities (parity:
+deeplearning4j-core plot/ — Tsne.java, BarnesHutTsne.java)."""
+
+from deeplearning4j_tpu.plot.tsne import Tsne, BarnesHutTsne

@@ -1,0 +1,9 @@
+"""Observability / UI (parity: deeplearning4j-ui-parent, ~24.9k LoC —
+SURVEY.md §2.11): StatsListener -> StatsStorage -> web dashboard."""
+
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+)
+from deeplearning4j_tpu.ui.server import UIServer

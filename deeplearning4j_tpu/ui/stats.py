@@ -1,0 +1,153 @@
+"""Stats collection.
+
+Parity: deeplearning4j-ui-model stats/BaseStatsListener.java (:287
+iterationDone gathers score, parameter/update histograms and
+mean-magnitudes, memory + timing) with StatsUpdateConfiguration-style
+knobs. One divergence, by design: the reference reads gradients off the
+stateful layers; here forward+backward+update fuse into one XLA step, so
+the listener records parameter UPDATE statistics (param delta between
+iterations — what LayerUpdater applied), which is what the reference's
+update charts show. Collection is O(params) host work — use
+``frequency`` to sample.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+@dataclass
+class StatsReport:
+    session_id: str
+    worker_id: str
+    timestamp: float
+    iteration: int
+    epoch: int
+    score: float
+    iteration_ms: Optional[float] = None
+    examples_per_sec: Optional[float] = None
+    memory_rss_mb: Optional[float] = None
+    param_stats: Dict[str, dict] = field(default_factory=dict)
+    update_stats: Dict[str, dict] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "timestamp": self.timestamp,
+            "iteration": self.iteration,
+            "epoch": self.epoch,
+            "score": self.score,
+            "iteration_ms": self.iteration_ms,
+            "examples_per_sec": self.examples_per_sec,
+            "memory_rss_mb": self.memory_rss_mb,
+            "param_stats": self.param_stats,
+            "update_stats": self.update_stats,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "StatsReport":
+        return StatsReport(**d)
+
+
+def _array_stats(a: np.ndarray, histograms: bool, bins: int) -> dict:
+    out = {
+        "mean": float(a.mean()),
+        "std": float(a.std()),
+        "mean_magnitude": float(np.abs(a).mean()),
+        "min": float(a.min()),
+        "max": float(a.max()),
+    }
+    if histograms:
+        counts, edges = np.histogram(a, bins=bins)
+        out["histogram"] = {"counts": counts.tolist(),
+                            "min": float(edges[0]), "max": float(edges[-1])}
+    return out
+
+
+def _rss_mb() -> Optional[float]:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        return None
+    return None
+
+
+class StatsListener(TrainingListener):
+    """Collects a StatsReport every ``frequency`` iterations and routes it
+    to a StatsStorage (BaseStatsListener parity)."""
+
+    def __init__(self, storage, frequency: int = 10, histograms: bool = True,
+                 bins: int = 20, session_id: Optional[str] = None,
+                 worker_id: str = "worker_0", collect_updates: bool = True):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.histograms = histograms
+        self.bins = bins
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.worker_id = worker_id
+        self.collect_updates = collect_updates
+        self._prev_params = None
+        self._last_time = None
+
+    def iteration_done(self, net, iteration, epoch):
+        now = time.perf_counter()
+        iter_ms = None
+        if self._last_time is not None:
+            iter_ms = 1000.0 * (now - self._last_time)
+        self._last_time = now
+        if iteration % self.frequency != 0:
+            if (self.collect_updates
+                    and (iteration + 1) % self.frequency == 0):
+                # host-copy params one iteration before the next sample so
+                # the update delta spans exactly one step (a host copy is
+                # required: the jitted step donates the old device buffers)
+                self._prev_params = jax.tree_util.tree_map(
+                    np.asarray, net.params)
+            return
+        flat = jax.tree_util.tree_flatten_with_path(net.params)[0]
+        param_stats, update_stats = {}, {}
+        for kp, leaf in flat:
+            key = jax.tree_util.keystr(kp)
+            a = np.asarray(leaf)
+            param_stats[key] = _array_stats(a, self.histograms, self.bins)
+        if self.collect_updates and self._prev_params is not None:
+            prev = jax.tree_util.tree_flatten_with_path(self._prev_params)[0]
+            for (kp, leaf), (_, prev_leaf) in zip(flat, prev):
+                key = jax.tree_util.keystr(kp)
+                delta = np.asarray(leaf) - np.asarray(prev_leaf)
+                update_stats[key] = _array_stats(delta, self.histograms,
+                                                 self.bins)
+        if self.collect_updates and self.frequency == 1:
+            self._prev_params = jax.tree_util.tree_map(np.asarray, net.params)
+        else:
+            self._prev_params = None
+        eps = None
+        n = getattr(net, "last_batch_examples", 0)
+        if iter_ms and n:
+            eps = 1000.0 * n / iter_ms
+        report = StatsReport(
+            session_id=self.session_id,
+            worker_id=self.worker_id,
+            timestamp=time.time(),
+            iteration=iteration,
+            epoch=epoch,
+            score=float(net.score_value),
+            iteration_ms=iter_ms,
+            examples_per_sec=eps,
+            memory_rss_mb=_rss_mb(),
+            param_stats=param_stats,
+            update_stats=update_stats,
+        )
+        self.storage.put_update(report)

@@ -1,0 +1,179 @@
+"""Dataset fetchers/iterators (synthetic fallback path), record readers,
+k-means, KD/VP trees, and t-SNE tests."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.datasets.fetchers import (
+    CifarDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataFetcher,
+    MnistDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.records import (
+    CollectionRecordReader,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+# ------------------------------------------------------------- fetchers
+def test_mnist_iterator_shapes_and_determinism():
+    it = MnistDataSetIterator(batch_size=32, num_examples=128, seed=5)
+    batches = list(it)
+    assert batches[0].features.shape == (32, 28, 28, 1)
+    assert batches[0].labels.shape == (32, 10)
+    assert sum(b.num_examples for b in batches) == 128
+    # deterministic synthetic data
+    ds1, desc1 = MnistDataFetcher().fetch(num_examples=16, seed=9)
+    ds2, desc2 = MnistDataFetcher().fetch(num_examples=16, seed=9)
+    np.testing.assert_array_equal(ds1.features, ds2.features)
+    assert desc1.synthetic  # no cached MNIST in this environment
+    assert 0.0 <= ds1.features.min() and ds1.features.max() <= 1.0
+
+
+def test_mnist_synthetic_is_learnable():
+    """The synthetic fallback must be class-separable so smoke tests and
+    benches exercise real learning."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+
+    it = MnistDataSetIterator(batch_size=128, num_examples=512, seed=1)
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+            .list()
+            .layer(Dense(n_out=64, activation="relu"))
+            .layer(Output(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=5, async_prefetch=False)
+    ds = DataSet(it.features, it.labels)
+    assert net.evaluate(ds).accuracy() > 0.9
+
+
+def test_iris_and_cifar_iterators():
+    iris = IrisDataSetIterator(batch_size=150)
+    ds = next(iter(iris))
+    assert ds.features.shape == (150, 4)
+    assert ds.labels.shape == (150, 3)
+    assert np.all(ds.labels.sum(axis=1) == 1.0)
+
+    cifar = CifarDataSetIterator(batch_size=16, num_examples=64)
+    b = next(iter(cifar))
+    assert b.features.shape == (16, 32, 32, 3)
+    assert b.labels.shape == (16, 10)
+
+
+def test_mnist_reads_cached_idx_files(tmp_path):
+    """When real IDX files exist in the cache dir, they are parsed (not the
+    synthetic path) — MnistManager parity."""
+    import struct
+
+    d = tmp_path / "mnist"
+    d.mkdir()
+    imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    with open(d / "train-images-idx3-ubyte", "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        for dim in imgs.shape:
+            f.write(struct.pack(">I", dim))
+        f.write(imgs.tobytes())
+    with open(d / "train-labels-idx1-ubyte", "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", 2))
+        f.write(np.array([3, 7], np.uint8).tobytes())
+    ds, desc = MnistDataFetcher().fetch(train=True, path=str(d))
+    assert not desc.synthetic
+    assert ds.features.shape == (2, 28, 28, 1)
+    assert ds.labels[0, 3] == 1.0 and ds.labels[1, 7] == 1.0
+    np.testing.assert_allclose(ds.features[0, 0, 1, 0], 1 / 255.0)
+
+
+# -------------------------------------------------------------- records
+def test_record_reader_classification_and_regression():
+    rows = [[0.1, 0.2, 1], [0.3, 0.4, 0], [0.5, 0.6, 2]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(rows),
+                                     batch_size=2, label_index=2,
+                                     num_classes=3)
+    b = next(iter(it))
+    assert b.features.shape == (2, 2)
+    assert b.labels.shape == (2, 3)
+    assert b.labels[0, 1] == 1.0
+
+    it_r = RecordReaderDataSetIterator(CollectionRecordReader(rows),
+                                       batch_size=3, label_index=2,
+                                       regression=True)
+    b = next(iter(it_r))
+    assert b.labels.shape == (3, 1)
+    np.testing.assert_allclose(b.labels[:, 0], [1, 0, 2])
+
+
+def test_sequence_record_reader_pads_and_masks():
+    seqs = [np.ones((3, 2)), np.ones((5, 2))]
+    it = SequenceRecordReaderDataSetIterator(seqs, [0, 1], batch_size=2,
+                                             num_classes=2)
+    b = next(iter(it))
+    assert b.features.shape == (2, 5, 2)
+    np.testing.assert_allclose(b.features_mask, [[1, 1, 1, 0, 0],
+                                                 [1, 1, 1, 1, 1]])
+    assert b.labels.shape == (2, 2)
+
+
+# ------------------------------------------------------------ clustering
+def cluster_data(seed=0, k=3, n=300, d=4):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 8, (k, d))
+    idx = rng.integers(0, k, n)
+    return centers[idx] + rng.normal(0, 0.6, (n, d)), idx
+
+
+def test_kmeans_recovers_clusters():
+    x, true = cluster_data()
+    km = KMeansClustering(k=3, seed=1).fit(x)
+    pred = km.predict(x)
+    # cluster purity: each predicted cluster is dominated by one true label
+    purity = 0
+    for c in range(3):
+        members = true[pred == c]
+        if len(members):
+            purity += np.bincount(members).max()
+    assert purity / len(true) > 0.95
+
+
+def test_kdtree_vptree_knn_match_bruteforce():
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(200, 5))
+    q = rng.normal(size=5)
+    brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+    kd = KDTree(pts)
+    vp = VPTree(pts)
+    kd_idx = sorted(i for i, _ in kd.knn(q, 5))
+    vp_idx = sorted(i for i, _ in vp.knn(q, 5))
+    assert kd_idx == sorted(brute.tolist())
+    assert vp_idx == sorted(brute.tolist())
+
+
+# ----------------------------------------------------------------- t-SNE
+@pytest.mark.parametrize("cls", [Tsne, BarnesHutTsne])
+def test_tsne_separates_clusters(cls):
+    x, true = cluster_data(seed=3, k=3, n=120, d=10)
+    ts = cls(n_components=2, perplexity=15, max_iter=300, seed=0)
+    y = ts.fit_transform(x)
+    assert y.shape == (120, 2)
+    assert np.isfinite(y).all()
+    # same-cluster pairs should be closer than cross-cluster pairs on average
+    same, cross = [], []
+    rng = np.random.default_rng(0)
+    for _ in range(400):
+        i, j = rng.integers(0, 120, 2)
+        if i == j:
+            continue
+        d = np.linalg.norm(y[i] - y[j])
+        (same if true[i] == true[j] else cross).append(d)
+    assert np.mean(same) < 0.5 * np.mean(cross), (np.mean(same),
+                                                  np.mean(cross))
