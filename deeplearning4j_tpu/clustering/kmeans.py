@@ -52,7 +52,13 @@ class KMeansClustering:
             d2 = np.asarray(jnp.min(
                 jnp.sum((x[:, None, :] - c[None, :, :]) ** 2, axis=-1),
                 axis=1))
-            probs = d2 / max(d2.sum(), 1e-12)
+            total = d2.sum()
+            if total <= 0:
+                # all remaining points coincide with chosen centroids
+                # (duplicates / k > distinct points): fall back to uniform
+                probs = np.full(n, 1.0 / n)
+            else:
+                probs = d2 / total
             idx.append(int(rng.choice(n, p=probs)))
         centroids = x[jnp.asarray(idx)]
         prev_cost = np.inf

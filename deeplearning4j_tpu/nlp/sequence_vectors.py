@@ -126,6 +126,11 @@ class SequenceVectors:
         """Train (SequenceVectors.fit :187 parity). ``sequences`` may be any
         re-iterable of token lists."""
         cfg = self.config
+        # Materialize one-shot iterators (iter(x) is x) so they survive the
+        # two passes (vocab build + training); re-iterable streaming corpora
+        # are left alone.
+        if iter(sequences) is sequences:
+            sequences = list(sequences)
         if self.vocab is None:
             self.build_vocab(sequences)
         seqs = self._sequences_to_indices(sequences)

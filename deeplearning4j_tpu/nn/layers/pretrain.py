@@ -135,6 +135,10 @@ class FrozenLayerWrapper(Layer):
     """Delegates forward to the wrapped layer; update-time freezing comes
     from resolve('updater') -> NoOp and zero regularization."""
 
+    # pinned (not delegated): pretraining a frozen layer is a guaranteed
+    # no-op, so skip it entirely
+    is_pretrainable = False
+
     def __init__(self, conf, input_type, global_conf, policy):
         super().__init__(conf, input_type, global_conf, policy)
         self.inner = conf.inner.make_layer(input_type, global_conf, policy)
@@ -161,6 +165,21 @@ class FrozenLayerWrapper(Layer):
     def regularization(self, params):
         return jnp.zeros((), self.param_dtype)
 
-    def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
+    def loss(self, params, x, labels, *, train=False, rng=None, mask=None,
+             **kwargs):
         return self.inner.loss(params, x, labels, train=train, rng=rng,
-                               mask=mask)
+                               mask=mask, **kwargs)
+
+    def update_centers(self, state, x, labels, mask=None):
+        """Frozen: the center-loss term still contributes to the loss (via
+        the delegated ``loss``/``loss_uses_state``), but centers do not
+        move."""
+        return state
+
+    def __getattr__(self, name):
+        # Delegate capability flags/hooks (e.g. ``loss_uses_state``) so
+        # wrapping an output layer does not silently drop loss terms.
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)

@@ -12,5 +12,10 @@ def last_unmasked_step(x, mask):
     if mask is None:
         return x[:, -1, :]
     m = mask.reshape(mask.shape[0], -1)
-    idx = jnp.maximum(jnp.sum(m, axis=1).astype(jnp.int32) - 1, 0)
+    # Index of the last nonzero mask entry (not sum-1, which is only right
+    # for contiguous prefix masks): supports ALIGN_END padding and gaps.
+    t = m.shape[1]
+    last_nz = (t - 1) - jnp.argmax(jnp.flip(m > 0, axis=1).astype(jnp.int32),
+                                   axis=1)
+    idx = jnp.where(jnp.any(m > 0, axis=1), last_nz, 0).astype(jnp.int32)
     return x[jnp.arange(x.shape[0]), idx, :]

@@ -23,6 +23,11 @@ from typing import Callable, List, Optional
 # ---------------------------------------------------------------------------
 
 class EpochTerminationCondition:
+    #: conditions calibrated for the (validation) score are only checked on
+    #: scoring epochs when evaluate_every_n_epochs > 1; epoch-count /
+    #: sanity conditions run every epoch
+    uses_validation_score = True
+
     def initialize(self):
         pass
 
@@ -41,6 +46,7 @@ class IterationTerminationCondition:
 @dataclass
 class MaxEpochsTermination(EpochTerminationCondition):
     max_epochs: int = 10
+    uses_validation_score = False
 
     def terminate(self, epoch, score):
         return epoch >= self.max_epochs - 1
@@ -82,6 +88,7 @@ class MaxScoreEpochTermination(EpochTerminationCondition):
     """Stop (diverged) if the score exceeds max_score."""
 
     max_score: float = 1e9
+    uses_validation_score = False  # divergence guard: check every epoch
 
     def terminate(self, epoch, score):
         return score > self.max_score
@@ -89,6 +96,8 @@ class MaxScoreEpochTermination(EpochTerminationCondition):
 
 @dataclass
 class InvalidScoreEpochTermination(EpochTerminationCondition):
+    uses_validation_score = False
+
     def terminate(self, epoch, score):
         return math.isnan(score) or math.isinf(score)
 
@@ -292,11 +301,16 @@ class EarlyStoppingTrainer:
                 if cfg.save_last_model:
                     cfg.model_saver.save_latest(self.net)
             else:
-                # off-schedule epochs still check terminations, against the
-                # latest training score (the reference checks every epoch)
+                # off-schedule epochs: only epoch-count/sanity conditions
+                # run (the raw last-batch training score is too noisy for
+                # validation-calibrated conditions and would pollute
+                # ScoreImprovement's counter)
                 score = float(self.net.score_value)
+            scoring_epoch = epoch % cfg.evaluate_every_n_epochs == 0
             stop_epoch = None
             for c in cfg.epoch_terminations:
+                if c.uses_validation_score and not scoring_epoch:
+                    continue
                 if c.terminate(epoch, score):
                     stop_epoch = (type(c).__name__,
                                   f"epoch {epoch}, score {score}")

@@ -177,3 +177,12 @@ def test_tsne_separates_clusters(cls):
         (same if true[i] == true[j] else cross).append(d)
     assert np.mean(same) < 0.5 * np.mean(cross), (np.mean(same),
                                                   np.mean(cross))
+
+
+def test_kmeans_duplicate_points_more_clusters_than_distinct():
+    # advisor round-1: k-means++ seeding must not crash when all remaining
+    # points coincide with chosen centroids (zero total distance)
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+    x = np.array([[1.0, 1.0]] * 6 + [[2.0, 2.0]] * 2, np.float32)
+    km = KMeansClustering(k=4, max_iterations=5, seed=0).fit(x)
+    assert km.centroids.shape == (4, 2)

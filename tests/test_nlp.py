@@ -222,3 +222,15 @@ def test_deepwalk_two_cliques():
     cross = np.mean([dw.similarity(0, 11), dw.similarity(1, 9),
                      dw.similarity(3, 8), dw.similarity(2, 10)])
     assert same > cross + 0.1, (same, cross)
+
+
+def test_sequence_vectors_accepts_one_shot_generator():
+    # advisor round-1: fit() used to iterate the corpus twice, silently
+    # training nothing when handed a generator
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    corpus = [["alpha", "beta", "gamma", "delta"] * 5,
+              ["alpha", "gamma", "beta", "delta"] * 5] * 10
+    w2v = Word2Vec(vector_size=16, min_word_frequency=1, epochs=1, seed=0)
+    w2v.fit(s for s in corpus)  # generator, not a list
+    vec = w2v.get_word_vector("alpha")
+    assert vec is not None and np.isfinite(np.asarray(vec)).all()

@@ -109,3 +109,31 @@ class TestInitializers:
         fn = initializers.distribution({"type": "normal", "mean": 5.0, "std": 0.1})
         w = fn(jax.random.PRNGKey(0), (1000,), 1000, 1, jnp.float32)
         assert abs(float(jnp.mean(w)) - 5.0) < 0.05
+
+
+class TestSequenceOps:
+    """Regression tests for masking helpers (advisor round-1 findings)."""
+
+    def test_last_unmasked_prefix_mask(self):
+        from deeplearning4j_tpu.ops.sequence import last_unmasked_step
+        x = jnp.arange(2 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 3)
+        mask = jnp.array([[1, 1, 1, 0], [1, 0, 0, 0]], jnp.float32)
+        out = last_unmasked_step(x, mask)
+        np.testing.assert_allclose(out, np.stack([x[0, 2], x[1, 0]]))
+
+    def test_last_unmasked_align_end_mask(self):
+        # zeros at the START (ALIGN_END padding) must select the last
+        # nonzero entry, not sum(mask)-1
+        from deeplearning4j_tpu.ops.sequence import last_unmasked_step
+        x = jnp.arange(2 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 3)
+        mask = jnp.array([[0, 0, 1, 1], [0, 1, 1, 1]], jnp.float32)
+        out = last_unmasked_step(x, mask)
+        np.testing.assert_allclose(out, np.stack([x[0, 3], x[1, 3]]))
+
+    def test_last_unmasked_gap_and_all_masked(self):
+        from deeplearning4j_tpu.ops.sequence import last_unmasked_step
+        x = jnp.arange(2 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 3)
+        mask = jnp.array([[1, 0, 1, 0], [0, 0, 0, 0]], jnp.float32)
+        out = last_unmasked_step(x, mask)
+        np.testing.assert_allclose(out[0], x[0, 2])
+        np.testing.assert_allclose(out[1], x[1, 0])  # all-masked clamps to 0

@@ -301,3 +301,63 @@ def test_vae_json_round_trip():
     assert vae.encoder_layer_sizes == (5, 4)
     assert vae.reconstruction.kind == "gaussian"
     assert vae.reconstruction.activation == "tanh"
+
+
+def test_frozen_center_loss_keeps_loss_term_and_freezes_centers():
+    # advisor round-1: wrapping CenterLossOutput in Frozen used to drop the
+    # center-loss term (loss_uses_state not delegated)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.1)).dtype(F64).list()
+            .layer(Dense(n_in=5, n_out=4, activation="tanh"))
+            .layer(Frozen(inner=CenterLossOutput(n_out=3, activation="softmax",
+                                                 lmbda=0.5, alpha=0.2)))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    frozen = net.layers[1]
+    assert getattr(frozen, "loss_uses_state", False)  # delegated flag
+
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 5)), np.eye(3)[rng.integers(0, 3, 8)])
+
+    # same net without freezing: scores must match (loss term included)
+    conf2 = (NeuralNetConfiguration.builder()
+             .seed(42).updater(Sgd(0.1)).dtype(F64).list()
+             .layer(Dense(n_in=5, n_out=4, activation="tanh"))
+             .layer(CenterLossOutput(n_out=3, activation="softmax",
+                                     lmbda=0.5, alpha=0.2))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    s_frozen = float(net.score(ds))
+    s_plain = float(net2.score(ds))
+    assert abs(s_frozen - s_plain) < 1e-9
+
+    # frozen centers do not move
+    name = frozen.name
+    c0 = np.asarray(net.state[name]["centers"]).copy()
+    net.fit_batch(ds)
+    c1 = np.asarray(net.state[name]["centers"])
+    np.testing.assert_allclose(c0, c1)
+
+
+def test_early_stopping_off_schedule_epochs_skip_score_conditions():
+    # advisor round-1: with evaluate_every_n_epochs > 1, validation-score
+    # conditions must not fire on noisy off-schedule training scores
+    from deeplearning4j_tpu.optimize.earlystopping import (
+        BestScoreEpochTermination, InvalidScoreEpochTermination,
+        MaxEpochsTermination, ScoreImprovementEpochTermination)
+    assert BestScoreEpochTermination.uses_validation_score
+    assert ScoreImprovementEpochTermination.uses_validation_score
+    assert not MaxEpochsTermination.uses_validation_score
+    assert not InvalidScoreEpochTermination.uses_validation_score
+    from deeplearning4j_tpu.optimize.earlystopping import MaxScoreEpochTermination
+    assert not MaxScoreEpochTermination.uses_validation_score
+
+
+def test_frozen_autoencoder_not_pretrainable():
+    from deeplearning4j_tpu.nn.conf.layers_pretrain import AutoEncoder as AE, Frozen as Fz
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Fz(inner=AE(n_in=6, n_out=4)))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert not getattr(net.layers[0], "is_pretrainable", False)
