@@ -66,6 +66,10 @@ class Glove:
         self.lookup = None
 
     def fit(self, sequences: Iterable[List[str]]):
+        # Materialize one-shot iterators: they must survive both the vocab
+        # pass and the co-occurrence pass below.
+        if iter(sequences) is sequences:
+            sequences = list(sequences)
         self.vocab = VocabConstructor(self.min_word_frequency).build(sequences)
         V, D = len(self.vocab), self.vector_size
         rng = np.random.default_rng(self.seed)

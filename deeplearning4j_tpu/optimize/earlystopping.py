@@ -287,7 +287,8 @@ class EarlyStoppingTrainer:
                 reason, details = stop_iter
                 break
 
-            if epoch % cfg.evaluate_every_n_epochs == 0:
+            scoring_epoch = epoch % cfg.evaluate_every_n_epochs == 0
+            if scoring_epoch:
                 if cfg.score_calculator is not None:
                     score = cfg.score_calculator.calculate_score(self.net)
                 else:
@@ -306,7 +307,6 @@ class EarlyStoppingTrainer:
                 # validation-calibrated conditions and would pollute
                 # ScoreImprovement's counter)
                 score = float(self.net.score_value)
-            scoring_epoch = epoch % cfg.evaluate_every_n_epochs == 0
             stop_epoch = None
             for c in cfg.epoch_terminations:
                 if c.uses_validation_score and not scoring_epoch:

@@ -1,0 +1,243 @@
+"""Training dashboard web server.
+
+Parity: deeplearning4j-play PlayUIServer.java (:53, singleton
+``getInstance`` :24, ``--uiPort`` flag) + the train-module charts. The
+reference runs a Play 2.x app polling StatsStorage; here a stdlib
+ThreadingHTTPServer serves a self-contained HTML/JS page (no external
+assets — works in zero-egress environments) that polls JSON endpoints
+backed by any attached ``BaseStatsStorage``:
+
+- ``GET /``                                    dashboard page
+- ``GET /api/sessions``                        session/worker inventory
+- ``GET /api/updates?session=S[&after=T]``     score/timing series
+- ``GET /api/model?session=S``                 latest param/update stats
+
+Use::
+
+    server = UIServer.get_instance(port=9000)
+    server.attach(storage)     # any InMemory/FileStatsStorage
+    ...
+    server.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.storage import BaseStatsStorage
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>deeplearning4j-tpu training UI</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
+header{background:#1a237e;color:#fff;padding:10px 18px;font-size:18px}
+.row{display:flex;flex-wrap:wrap;gap:14px;padding:14px}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;
+      min-width:420px;flex:1}
+h3{margin:2px 0 8px;font-size:14px;color:#444}
+svg{width:100%;height:220px}
+table{border-collapse:collapse;font-size:12px;width:100%}
+td,th{border-bottom:1px solid #eee;padding:3px 6px;text-align:right}
+th:first-child,td:first-child{text-align:left}
+select{margin-left:12px}
+.stat{font-size:22px;font-weight:600}
+.label{font-size:11px;color:#777}
+</style></head><body>
+<header>deeplearning4j-tpu — training dashboard
+<select id="session"></select></header>
+<div class="row">
+ <div class="card"><h3>Score vs iteration</h3><svg id="score"></svg></div>
+ <div class="card"><h3>Iteration time (ms) / examples-sec</h3>
+   <svg id="perf"></svg></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Latest</h3><div id="latest"></div></div>
+ <div class="card"><h3>Parameter mean magnitudes (latest)</h3>
+   <div id="model"></div></div>
+</div>
+<script>
+function line(svg, xs, ys, color){
+  const el = document.getElementById(svg); el.innerHTML = "";
+  if (xs.length < 2) return;
+  const W = el.clientWidth || 480, H = el.clientHeight || 220, P = 30;
+  const xmin=Math.min(...xs), xmax=Math.max(...xs);
+  const finite = ys.filter(Number.isFinite);
+  if (!finite.length) return;
+  const ymin=Math.min(...finite), ymax=Math.max(...finite);
+  const sx=x=>P+(W-2*P)*(x-xmin)/Math.max(xmax-xmin,1e-9);
+  const sy=y=>H-P-(H-2*P)*(y-ymin)/Math.max(ymax-ymin,1e-9);
+  let d="";
+  xs.forEach((x,i)=>{ if(Number.isFinite(ys[i]))
+      d += (d?"L":"M")+sx(x).toFixed(1)+","+sy(ys[i]).toFixed(1); });
+  el.innerHTML =
+   `<line x1="${P}" y1="${H-P}" x2="${W-P}" y2="${H-P}" stroke="#bbb"/>`+
+   `<line x1="${P}" y1="${P}" x2="${P}" y2="${H-P}" stroke="#bbb"/>`+
+   `<text x="${P}" y="${P-6}" font-size="10" fill="#888">`+
+     `${ymax.toPrecision(4)}</text>`+
+   `<text x="${P}" y="${H-P+12}" font-size="10" fill="#888">`+
+     `${ymin.toPrecision(4)}</text>`+
+   `<path d="${d}" fill="none" stroke="${color}" stroke-width="1.6"/>`;
+}
+async function refresh(){
+  const sess = document.getElementById("session").value;
+  if (!sess) return;
+  const u = await (await fetch("/api/updates?session="+
+                   encodeURIComponent(sess))).json();
+  line("score", u.iterations, u.scores, "#1a73e8");
+  line("perf", u.iterations, u.iteration_ms, "#e8710a");
+  const last = u.latest;
+  if (last) document.getElementById("latest").innerHTML =
+    `<span class="stat">${Number(last.score).toPrecision(5)}</span>
+     <span class="label">score</span> &nbsp;
+     <span class="stat">${last.iteration}</span>
+     <span class="label">iteration</span> &nbsp;
+     <span class="stat">${last.examples_per_sec ?
+        Math.round(last.examples_per_sec) : "—"}</span>
+     <span class="label">examples/sec</span> &nbsp;
+     <span class="stat">${last.memory_rss_mb ?
+        Math.round(last.memory_rss_mb) : "—"}</span>
+     <span class="label">host MB</span>`;
+  const m = await (await fetch("/api/model?session="+
+                   encodeURIComponent(sess))).json();
+  let rows = "<table><tr><th>parameter</th><th>mean |w|</th>" +
+             "<th>mean |Δw|</th><th>Δ ratio</th></tr>";
+  for (const [k, v] of Object.entries(m.param_stats || {})){
+    const up = (m.update_stats||{})[k] || {};
+    const ratio = up.mean_magnitude && v.mean_magnitude ?
+      (up.mean_magnitude/v.mean_magnitude).toExponential(2) : "—";
+    rows += `<tr><td>${k}</td><td>${v.mean_magnitude.toExponential(3)}</td>
+      <td>${up.mean_magnitude ? up.mean_magnitude.toExponential(3) : "—"}</td>
+      <td>${ratio}</td></tr>`;
+  }
+  document.getElementById("model").innerHTML = rows + "</table>";
+}
+async function init(){
+  const s = await (await fetch("/api/sessions")).json();
+  const sel = document.getElementById("session");
+  sel.innerHTML = s.sessions.map(x=>`<option>${x}</option>`).join("");
+  sel.onchange = refresh;
+  await refresh();
+  setInterval(refresh, 2000);
+}
+init();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4j-tpu-ui/1.0"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, body: bytes, ctype: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._send(json.dumps(obj).encode(), "application/json", code)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        ui: "UIServer" = self.server.ui_server  # type: ignore[attr-defined]
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        if url.path == "/":
+            self._send(_PAGE.encode(), "text/html; charset=utf-8")
+        elif url.path == "/api/sessions":
+            self._json(ui.sessions_payload())
+        elif url.path == "/api/updates":
+            sess = q.get("session", "")
+            after = float(q.get("after", "-inf"))
+            self._json(ui.updates_payload(sess, after))
+        elif url.path == "/api/model":
+            self._json(ui.model_payload(q.get("session", "")))
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """Singleton dashboard server over attached StatsStorage instances."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+        self.storages: List[BaseStatsStorage] = []
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.ui_server = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]  # resolved if port=0
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dl4j-tpu-ui-server")
+        self._thread.start()
+
+    # PlayUIServer.getInstance() parity
+    @classmethod
+    def get_instance(cls, port: int = 9000,
+                     host: str = "127.0.0.1") -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port=port, host=host)
+        return cls._instance
+
+    def attach(self, storage: BaseStatsStorage) -> None:
+        if storage not in self.storages:
+            self.storages.append(storage)
+
+    def detach(self, storage: BaseStatsStorage) -> None:
+        self.storages = [s for s in self.storages if s is not storage]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    # ------------------------------------------------------ JSON payloads
+    def _find(self, session_id: str) -> Optional[BaseStatsStorage]:
+        for s in self.storages:
+            if session_id in s.list_session_ids():
+                return s
+        return None
+
+    def sessions_payload(self) -> dict:
+        sessions = []
+        for s in self.storages:
+            sessions.extend(s.list_session_ids())
+        return {"sessions": sorted(set(sessions))}
+
+    def updates_payload(self, session_id: str, after: float) -> dict:
+        storage = self._find(session_id)
+        if storage is None:
+            return {"iterations": [], "scores": [], "iteration_ms": [],
+                    "examples_per_sec": [], "latest": None}
+        reports = storage.get_all_updates_after(session_id, after)
+        latest = reports[-1].to_dict() if reports else None
+        if latest:
+            latest.pop("param_stats", None)
+            latest.pop("update_stats", None)
+        return {
+            "iterations": [r.iteration for r in reports],
+            "scores": [r.score for r in reports],
+            "iteration_ms": [r.iteration_ms for r in reports],
+            "examples_per_sec": [r.examples_per_sec for r in reports],
+            "latest": latest,
+        }
+
+    def model_payload(self, session_id: str) -> dict:
+        storage = self._find(session_id)
+        latest = storage.get_latest_update(session_id) if storage else None
+        if latest is None:
+            return {"param_stats": {}, "update_stats": {}}
+        return {"param_stats": latest.param_stats,
+                "update_stats": latest.update_stats}

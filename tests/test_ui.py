@@ -1,0 +1,176 @@
+"""UI tier: StatsListener -> storage -> dashboard server
+(TestStatsStorage.java + PlayUIServer analogue)."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.ui  # the package itself must import (round-1 bug)
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsListener,
+    StatsReport,
+    UIServer,
+)
+from deeplearning4j_tpu.ui.storage import NEW_SESSION, POST_UPDATE
+
+
+def _report(session="s1", worker="w0", iteration=0, score=1.0, ts=None):
+    return StatsReport(
+        session_id=session, worker_id=worker,
+        timestamp=ts if ts is not None else 1000.0 + iteration,
+        iteration=iteration, epoch=0, score=score,
+        iteration_ms=5.0, examples_per_sec=1e4, memory_rss_mb=100.0,
+        param_stats={"['l0']['w']": {"mean": 0.0, "std": 1.0,
+                                     "mean_magnitude": 0.8,
+                                     "min": -3.0, "max": 3.0}},
+        update_stats={"['l0']['w']": {"mean": 0.0, "std": 1e-3,
+                                      "mean_magnitude": 8e-4,
+                                      "min": -0.01, "max": 0.01}},
+    )
+
+
+def test_stats_report_round_trip():
+    r = _report(iteration=7, score=0.5)
+    r2 = StatsReport.from_dict(json.loads(json.dumps(r.to_dict())))
+    assert r2 == r
+
+
+def test_in_memory_storage_api_and_listeners():
+    st = InMemoryStatsStorage()
+    events = []
+    st.register_listener(lambda ev, s, w: events.append((ev, s, w)))
+    for i in range(5):
+        st.put_update(_report(iteration=i, score=1.0 / (i + 1)))
+    st.put_update(_report(session="s2", worker="wA", iteration=0))
+    st.put_static_info("s1", "w0", {"model": "mlp", "params": 123})
+
+    assert st.list_session_ids() == ["s1", "s2"]
+    assert st.list_worker_ids_for_session("s1") == ["w0"]
+    assert st.num_updates("s1") == 5
+    assert st.get_latest_update("s1").iteration == 4
+    after = st.get_all_updates_after("s1", 1002.0)
+    assert [r.iteration for r in after] == [3, 4]
+    assert st.get_static_info("s1", "w0")["model"] == "mlp"
+    assert (NEW_SESSION, "s1", "w0") in events
+    assert sum(1 for e in events if e[0] == POST_UPDATE) == 6
+
+
+def test_file_storage_persists_and_reloads(tmp_path):
+    path = os.path.join(tmp_path, "stats.jsonl")
+    st = FileStatsStorage(path)
+    for i in range(4):
+        st.put_update(_report(iteration=i, score=2.0 - i * 0.1))
+    st.put_static_info("s1", "w0", {"model": "lenet"})
+    st.close()
+
+    st2 = FileStatsStorage(path)  # reload from disk
+    assert st2.list_session_ids() == ["s1"]
+    assert st2.num_updates("s1") == 4
+    assert st2.get_latest_update("s1").score == pytest.approx(1.7)
+    assert st2.get_static_info("s1", "w0") == {"model": "lenet"}
+    # appends after reload land in the same file
+    st2.put_update(_report(iteration=9))
+    st2.close()
+    st3 = FileStatsStorage(path)
+    assert st3.num_updates("s1") == 5
+    st3.close()
+
+
+def test_file_storage_survives_torn_tail_write(tmp_path):
+    path = os.path.join(tmp_path, "stats.jsonl")
+    st = FileStatsStorage(path)
+    st.put_update(_report(iteration=0))
+    st.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "update", "report": {"sess')  # simulated crash
+    st2 = FileStatsStorage(path)
+    assert st2.num_updates("s1") == 1
+    st2.close()
+
+
+def test_stats_listener_collects_during_training():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 10)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(Dense(n_in=10, n_out=8, activation="relu"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    st = InMemoryStatsStorage()
+    net.add_listener(StatsListener(st, frequency=1, session_id="train"))
+    net.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+
+    reports = st.get_all_updates("train")
+    assert len(reports) == 8  # 4 batches x 2 epochs
+    assert all(np.isfinite(r.score) for r in reports)
+    last = reports[-1]
+    assert last.param_stats and last.update_stats
+    for s in last.param_stats.values():
+        assert {"mean", "std", "mean_magnitude", "histogram"} <= set(s)
+    # update deltas are nonzero while training
+    assert any(s["mean_magnitude"] > 0 for s in last.update_stats.values())
+
+
+def test_ui_server_serves_dashboard_and_json():
+    st = InMemoryStatsStorage()
+    for i in range(6):
+        st.put_update(_report(iteration=i, score=1.0 - 0.1 * i))
+    server = UIServer(port=0)  # ephemeral port; not the singleton
+    try:
+        server.attach(st)
+
+        def get(path):
+            with urllib.request.urlopen(server.url.rstrip("/") + path,
+                                        timeout=5) as resp:
+                return resp.status, resp.read()
+
+        code, body = get("/")
+        assert code == 200 and b"training dashboard" in body
+
+        code, body = get("/api/sessions")
+        assert json.loads(body) == {"sessions": ["s1"]}
+
+        code, body = get("/api/updates?session=s1")
+        payload = json.loads(body)
+        assert payload["iterations"] == list(range(6))
+        assert payload["latest"]["score"] == pytest.approx(0.5)
+        assert "param_stats" not in payload["latest"]  # trimmed
+
+        code, body = get("/api/updates?session=s1&after=1002.5")
+        assert json.loads(body)["iterations"] == [3, 4, 5]
+
+        code, body = get("/api/model?session=s1")
+        model = json.loads(body)
+        assert "['l0']['w']" in model["param_stats"]
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get("/api/nope")
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_ui_server_singleton():
+    s1 = UIServer.get_instance(port=0)
+    try:
+        assert UIServer.get_instance() is s1
+    finally:
+        s1.stop()
+    s2 = UIServer.get_instance(port=0)
+    try:
+        assert s2 is not s1
+    finally:
+        s2.stop()
