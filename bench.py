@@ -1,11 +1,30 @@
 """Benchmark entry point (run by the driver on real TPU hardware).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Measures training throughput (examples/sec) of the flagship model's jitted
-train step on MNIST-shaped data. The reference publishes no numbers
-(BASELINE.md), so vs_baseline is reported against a recorded local CPU-era
-reference point once established; 1.0 until then.
+Measures the jitted train step of the BASELINE.md configs with
+device-resident minibatches (host->device transfer is the input
+pipeline's job — AsyncDataSetIterator overlaps it; here we measure the
+training step the way the reference's cuDNN-path benchmarks do):
+
+- mnist_mlp   f32  batch 1024 (round-1 continuity metric)
+- lenet       bf16 batch 256  (baseline #1, conv stack)
+- resnet50    bf16 batch 256  (baseline #2, the north-star: img/sec/chip + MFU)
+- char_rnn    bf16 batch 32 x seq 64 (baseline #3, LSTM scan)
+
+Timing is slope-based: run two window sizes via ``fit_batch_repeated``
+(n steps fused into ONE XLA execution by lax.scan — removes per-step host
+dispatch), each window ended by a device->host scalar read (the only
+reliable execution barrier through a remote-TPU tunnel, where
+block_until_ready can return before the queue drains), and take
+(t_large - t_small) / (n_large - n_small). This cancels the fixed
+barrier/dispatch cost and reports honest steady-state device step time.
+
+MFU = measured FLOP/s / peak FLOP/s, with per-step FLOPs taken from XLA's
+own cost model (jit(...).lower(...).compile().cost_analysis()['flops'])
+and peak from the device kind (bf16 matmul peak). The primary line is
+ResNet-50 images/sec/chip; vs_baseline is achieved MFU / 0.40 (the
+BASELINE.md acceptance bar — the reference publishes no numbers).
 """
 
 from __future__ import annotations
@@ -15,39 +34,140 @@ import time
 
 import numpy as np
 
+# bf16 matmul peak FLOP/s by device kind prefix (public spec numbers)
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,        # trillium
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in _PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def _bench_net(net, features, labels, *, scan_len=20, is_graph: bool):
+    """Warm up, time fit_batch with device-resident data, and pull per-step
+    FLOPs from the compiled step's cost analysis."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+    x = jnp.asarray(features)
+    y = jnp.asarray(labels)
+    ds = MultiDataSet([x], [y]) if is_graph else DataSet(x, y)
+
+    net.fit_batch(ds)  # compile the single step (also used for FLOP count)
+    float(net.score_value)
+
+    n = scan_len
+
+    def window(k):
+        """k back-to-back scan executions, one host-read barrier at the
+        end; returns wall time."""
+        t0 = time.perf_counter()
+        for _ in range(k):
+            net.fit_batch_repeated(ds, n)
+        float(net.score_value)
+        return time.perf_counter() - t0
+
+    window(1)  # compile the scanned step, absorb stragglers
+    t1 = window(1)
+    t3 = window(3)
+    sec_per_step = max((t3 - t1) / (2 * n), 1e-9)
+
+    flops = None
+    try:
+        rng = jax.random.PRNGKey(0)
+        it = jnp.asarray(0, jnp.int32)
+        if is_graph:
+            args = (net.params, net.state, net.opt_state, it,
+                    {net.conf.network_inputs[0]: x}, [y], {}, None, rng)
+        else:
+            args = (net.params, net.state, net.opt_state, it, x, y,
+                    None, None, rng)
+        cost = net._train_step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        if cost:
+            flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    batch = int(x.shape[0])
+    out = {
+        "step_ms": round(1000.0 * sec_per_step, 3),
+        "examples_per_sec": round(batch / sec_per_step, 1),
+        "batch": batch,
+    }
+    peak = _peak_flops(jax.devices()[0])
+    if flops is not None:
+        out["step_gflops"] = round(flops / 1e9, 2)
+        if peak:
+            out["mfu"] = round(flops / sec_per_step / peak, 4)
+    return out
+
 
 def main():
     import jax
 
-    from __graft_entry__ import _flagship
-    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu import zoo
 
-    net = _flagship()
-
-    batch = 1024
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch, 784)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
-    ds = DataSet(x, y)
+    results = {}
 
-    # warmup (compile)
-    for _ in range(3):
-        net.fit_batch(ds)
-    jax.block_until_ready(net.params)
+    # --- MLP (round-1 continuity) ---------------------------------------
+    net = zoo.mnist_mlp()
+    results["mnist_mlp"] = _bench_net(
+        net,
+        rng.normal(size=(1024, 784)).astype(np.float32),
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, 1024)],
+        scan_len=100, is_graph=False)
 
-    steps = 50
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        net.fit_batch(ds)
-    jax.block_until_ready(net.params)
-    dt = time.perf_counter() - t0
+    # --- LeNet (baseline #1) --------------------------------------------
+    net = zoo.lenet()
+    results["lenet"] = _bench_net(
+        net,
+        rng.normal(size=(256, 28, 28, 1)).astype(np.float32),
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, 256)],
+        scan_len=50, is_graph=False)
 
-    examples_per_sec = steps * batch / dt
+    # --- ResNet-50 (baseline #2, primary) -------------------------------
+    net = zoo.resnet50()
+    results["resnet50"] = _bench_net(
+        net,
+        rng.normal(size=(256, 224, 224, 3)).astype(np.float32),
+        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, 256)],
+        scan_len=10, is_graph=True)
+
+    # --- GravesLSTM char-RNN (baseline #3) ------------------------------
+    net = zoo.char_rnn(vocab_size=80, hidden=512, n_layers=2)
+    ids = rng.integers(0, 80, (32, 64))
+    results["char_rnn"] = _bench_net(
+        net,
+        np.eye(80, dtype=np.float32)[ids],
+        np.eye(80, dtype=np.float32)[rng.integers(0, 80, (32, 64))],
+        scan_len=20, is_graph=False)
+    # tokens/sec is the natural unit for the LSTM
+    results["char_rnn"]["tokens_per_sec"] = round(
+        results["char_rnn"]["examples_per_sec"] * 64, 1)
+
+    primary = results["resnet50"]
+    mfu = primary.get("mfu")
     print(json.dumps({
-        "metric": "mnist_mlp_train_throughput",
-        "value": round(examples_per_sec, 1),
-        "unit": "examples/sec",
-        "vs_baseline": 1.0,
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": primary["examples_per_sec"],
+        "unit": "images/sec/chip",
+        # BASELINE.md bar: >=40% MFU (reference publishes no numbers)
+        "vs_baseline": round(mfu / 0.40, 3) if mfu else 1.0,
+        "extra": results,
     }))
 
 

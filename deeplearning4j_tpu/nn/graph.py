@@ -40,6 +40,7 @@ class ComputationGraph:
         self.listeners: list = []
         self.score_value = None
         self._train_step = None
+        self._multi_steps = {}
         self._apply_fns = {}
         self._mesh = None
         self._rng_key = None
@@ -115,6 +116,7 @@ class ComputationGraph:
             self.params, self.state, self.opt_state = init_trees(self._rng_key)
         self.iteration = 0
         self._train_step = None
+        self._multi_steps = {}
         self._apply_fns = {}
         return self
 
@@ -143,6 +145,7 @@ class ComputationGraph:
         from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
         self._mesh = (mesh, data_axis)
         self._train_step = None
+        self._multi_steps = {}
         self._apply_fns = {}
         apply_mesh(self, mesh, data_axis)
         return self
@@ -247,7 +250,8 @@ class ComputationGraph:
         return total, new_state
 
     # ---------------------------------------------------------- train step
-    def _build_train_step(self):
+    def _step_fn(self):
+        """The raw (un-jitted) fused train step: fwd+bwd+normalize+update."""
         gc = self.conf.global_conf
         layers = self.layers
 
@@ -264,11 +268,63 @@ class ComputationGraph:
                 layers, gc, params, grads, opt_state, it)
             return new_params, new_state, new_opt, score
 
+        return step_fn
+
+    def _build_train_step(self):
+        step_fn = self._step_fn()
         if self._mesh is not None:
             from deeplearning4j_tpu.parallel.data_parallel import (
                 shard_step_multi)
             return shard_step_multi(self, step_fn, *self._mesh)
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def fit_batch_repeated(self, mds, n_steps: int):
+        """Run ``n_steps`` optimization steps on one minibatch inside a
+        SINGLE XLA execution (``lax.scan`` over the fused train step) —
+        one host dispatch instead of n. See
+        MultiLayerNetwork.fit_batch_repeated."""
+        self._require_init()
+        mds = self._coerce(mds)
+        if self._mesh is not None or self.conf.backprop_type == "tbptt":
+            # meshed execution needs shard_step_multi's batch handling;
+            # tbptt keeps fit_batch's (currently unsupported) semantics
+            for _ in range(n_steps):
+                score = self.fit_batch(mds)
+            return score
+        jitted = self._multi_steps.get(n_steps)
+        if jitted is None:
+            step_fn = self._step_fn()
+
+            def multi(params, state, opt_state, it0, inputs, labels, fmasks,
+                      lmasks, rng):
+                def body(carry, i):
+                    p, s, o, key = carry
+                    key, sub = jax.random.split(key)
+                    p, s, o, score = step_fn(p, s, o, it0 + i, inputs,
+                                             labels, fmasks, lmasks, sub)
+                    return (p, s, o, key), score
+
+                (p, s, o, _), scores = jax.lax.scan(
+                    body, (params, state, opt_state, rng),
+                    jnp.arange(n_steps))
+                return p, s, o, scores[-1]
+
+            jitted = jax.jit(multi, donate_argnums=(0, 1, 2))
+            self._multi_steps[n_steps] = jitted
+        self._rng_key, rng = jax.random.split(self._rng_key)
+        inputs, fmasks = self._prepare_inputs(mds.features, mds.features_masks)
+        labels = [jnp.asarray(l) for l in mds.labels]
+        lmasks = [None if m is None else jnp.asarray(m)
+                  for m in mds.labels_masks]
+        if all(m is None for m in lmasks):
+            lmasks = None
+        it = jnp.asarray(self.iteration, jnp.int32)
+        self.params, self.state, self.opt_state, score = jitted(
+            self.params, self.state, self.opt_state, it, inputs, labels,
+            fmasks, lmasks, rng)
+        self.iteration += n_steps
+        self.score_value = score
+        return score
 
     @staticmethod
     def _coerce(data) -> MultiDataSet:

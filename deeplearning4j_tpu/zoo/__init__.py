@@ -1,0 +1,13 @@
+"""Model zoo: canonical network builders (reference parity:
+TrainedModels.java / ModelGuesser.java model-zoo hooks, and the configs
+BASELINE.md measures — LeNet-MNIST, ResNet-50, GravesLSTM char-RNN)."""
+
+from deeplearning4j_tpu.zoo.models import (
+    char_rnn,
+    lenet,
+    mnist_mlp,
+    resnet18,
+    resnet50,
+)
+
+__all__ = ["char_rnn", "lenet", "mnist_mlp", "resnet18", "resnet50"]

@@ -1,0 +1,199 @@
+"""Canonical model builders for the baseline configs (BASELINE.md #1-#3).
+
+These are the TPU-native renderings of the reference's flagship example
+nets: LeNet on MNIST (MultiLayerNetwork.fit path,
+deeplearning4j-nn/.../MultiLayerNetwork.java:947), ResNet-v1 bottleneck
+graphs (ComputationGraph.fit path, ComputationGraph.java:701 + the
+CudnnConvolutionHelper.java:49 conv stack), and a GravesLSTM char-RNN
+(LSTMHelpers.java:57,271). All convs are NHWC (TPU-preferred layout; the
+lowering handles it — the reference is NCHW at the API only).
+
+By default conv/LSTM models use bf16 compute with f32 master params — the
+MXU-native dtype policy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer, Dense,
+                                               Output)
+from deeplearning4j_tpu.nn.conf.layers_conv import (
+    BatchNorm,
+    Convolution2D,
+    GlobalPooling,
+    Subsampling,
+)
+from deeplearning4j_tpu.nn.conf.layers_recurrent import GravesLSTM, RnnOutput
+from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Nesterovs
+
+BF16 = DtypePolicy(param_dtype="float32", compute_dtype="bfloat16")
+F32 = DtypePolicy(param_dtype="float32", compute_dtype="float32")
+
+
+def mnist_mlp(seed: int = 42, dtype: Optional[DtypePolicy] = None
+              ) -> MultiLayerNetwork:
+    """784-256-128-10 MLP (the round-1 smoke/bench model)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-3)).activation("relu")
+            .dtype(dtype or F32)
+            .list()
+            .layer(Dense(n_out=256))
+            .layer(Dense(n_out=128))
+            .layer(Output(n_out=10, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def lenet(seed: int = 42, n_classes: int = 10,
+          dtype: Optional[DtypePolicy] = None) -> MultiLayerNetwork:
+    """LeNet MNIST (baseline config #1): conv5x5x20 -> maxpool2 ->
+    conv5x5x50 -> maxpool2 -> dense500 -> softmax (the canonical DL4J
+    LeNet example topology)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Nesterovs(0.01, 0.9)).activation("relu")
+            .dtype(dtype or BF16)
+            .list()
+            .layer(Convolution2D(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                 activation="identity"))
+            .layer(Subsampling(kernel=(2, 2), stride=(2, 2), pooling="max"))
+            .layer(Convolution2D(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                 activation="identity"))
+            .layer(Subsampling(kernel=(2, 2), stride=(2, 2), pooling="max"))
+            .layer(Dense(n_out=500, activation="relu"))
+            .layer(Output(n_out=n_classes, loss="mcxent",
+                          activation="softmax"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _conv_bn(g, name: str, n_out: int, kernel, stride, inputs: str,
+             activation: str = "relu"):
+    g.add_layer(f"{name}_conv",
+                Convolution2D(n_out=n_out, kernel=kernel, stride=stride,
+                              mode="same", has_bias=False,
+                              activation="identity"),
+                inputs)
+    g.add_layer(f"{name}_bn", BatchNorm(), f"{name}_conv")
+    if activation != "identity":
+        g.add_layer(f"{name}_act", ActivationLayer(activation=activation),
+                    f"{name}_bn")
+        return f"{name}_act"
+    return f"{name}_bn"
+
+
+def _bottleneck(g, name: str, inputs: str, filters: int, stride: int,
+                project: bool) -> str:
+    """ResNet-v1 bottleneck: 1x1 (reduce) -> 3x3 -> 1x1 (expand, x4), with
+    an identity or projection shortcut."""
+    x = _conv_bn(g, f"{name}_a", filters, (1, 1), (stride, stride), inputs)
+    x = _conv_bn(g, f"{name}_b", filters, (3, 3), (1, 1), x)
+    x = _conv_bn(g, f"{name}_c", filters * 4, (1, 1), (1, 1), x,
+                 activation="identity")
+    if project:
+        shortcut = _conv_bn(g, f"{name}_proj", filters * 4, (1, 1),
+                            (stride, stride), inputs, activation="identity")
+    else:
+        shortcut = inputs
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, shortcut)
+    g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                f"{name}_add")
+    return f"{name}_out"
+
+
+def _basic_block(g, name: str, inputs: str, filters: int, stride: int,
+                 project: bool) -> str:
+    """ResNet-v1 basic block (3x3 -> 3x3) for ResNet-18/34."""
+    x = _conv_bn(g, f"{name}_a", filters, (3, 3), (stride, stride), inputs)
+    x = _conv_bn(g, f"{name}_b", filters, (3, 3), (1, 1), x,
+                 activation="identity")
+    if project:
+        shortcut = _conv_bn(g, f"{name}_proj", filters, (1, 1),
+                            (stride, stride), inputs, activation="identity")
+    else:
+        shortcut = inputs
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, shortcut)
+    g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                f"{name}_add")
+    return f"{name}_out"
+
+
+def _resnet(stage_blocks, block_fn, bottleneck: bool, *, image_size: int,
+            n_classes: int, seed: int, dtype: Optional[DtypePolicy],
+            updater=None) -> ComputationGraph:
+    g = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(updater or Nesterovs(0.1, 0.9))
+         .dtype(dtype or BF16)
+         .graph_builder()
+         .add_inputs("img"))
+    x = _conv_bn(g, "stem", 64, (7, 7), (2, 2), "img")
+    g.add_layer("stem_pool",
+                Subsampling(kernel=(3, 3), stride=(2, 2), pooling="max",
+                            mode="same"),
+                x)
+    x = "stem_pool"
+    filters = 64
+    in_ch = 64
+    for stage, n_blocks in enumerate(stage_blocks):
+        out_ch = filters * 4 if bottleneck else filters
+        for b in range(n_blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            # projection shortcut only where shapes change (canonical
+            # ResNet: identity everywhere else)
+            project = b == 0 and (stride != 1 or in_ch != out_ch)
+            x = block_fn(g, f"s{stage}b{b}", x, filters, stride, project)
+            in_ch = out_ch
+        filters *= 2
+    g.add_layer("head_pool", GlobalPooling(pooling="avg"), x)
+    g.add_layer("fc", Output(n_out=n_classes, loss="mcxent",
+                             activation="softmax"), "head_pool")
+    conf = (g.set_outputs("fc")
+            .set_input_types(InputType.convolutional(image_size, image_size,
+                                                     3))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+
+def resnet50(seed: int = 42, n_classes: int = 1000, image_size: int = 224,
+             dtype: Optional[DtypePolicy] = None,
+             updater=None) -> ComputationGraph:
+    """ResNet-50 v1 (baseline config #2): bottleneck stages [3, 4, 6, 3]."""
+    return _resnet([3, 4, 6, 3], _bottleneck, True, image_size=image_size,
+                   n_classes=n_classes, seed=seed, dtype=dtype,
+                   updater=updater)
+
+
+def resnet18(seed: int = 42, n_classes: int = 10, image_size: int = 32,
+             dtype: Optional[DtypePolicy] = None,
+             updater=None) -> ComputationGraph:
+    """ResNet-18 (baseline config #5's CIFAR-10 model): basic-block stages
+    [2, 2, 2, 2]; defaults sized for CIFAR."""
+    return _resnet([2, 2, 2, 2], _basic_block, False, image_size=image_size,
+                   n_classes=n_classes, seed=seed, dtype=dtype,
+                   updater=updater)
+
+
+def char_rnn(vocab_size: int = 80, hidden: int = 512, n_layers: int = 2,
+             seed: int = 42, dtype: Optional[DtypePolicy] = None
+             ) -> MultiLayerNetwork:
+    """GravesLSTM char-RNN (baseline config #3): stacked LSTMs ->
+    per-timestep softmax (the reference's LSTMHelpers example shape)."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(2e-3)).dtype(dtype or BF16)
+         .list())
+    for _ in range(n_layers):
+        b = b.layer(GravesLSTM(n_out=hidden, activation="tanh"))
+    conf = (b.layer(RnnOutput(n_out=vocab_size, loss="mcxent",
+                              activation="softmax"))
+            .set_input_type(InputType.recurrent(vocab_size))
+            .build())
+    return MultiLayerNetwork(conf).init()

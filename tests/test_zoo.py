@@ -1,0 +1,98 @@
+"""Model zoo: the BASELINE.md configs build, train, and (for the scanned
+multi-step path) match step-by-step training exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import zoo
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.zoo.models import F32
+
+
+def test_lenet_builds_and_trains():
+    net = zoo.lenet(dtype=F32)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    s0 = float(net.fit_batch(DataSet(x, y)))
+    for _ in range(10):
+        s = float(net.fit_batch(DataSet(x, y)))
+    assert np.isfinite(s) and s < s0  # loss decreases on a fixed batch
+    out = np.asarray(net.output(x))
+    assert out.shape == (8, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_resnet18_builds_and_trains():
+    from deeplearning4j_tpu.nn.updater import Adam
+    net = zoo.resnet18(dtype=F32, updater=Adam(1e-3))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    mds = MultiDataSet([x], [y])
+    s0 = float(net.fit_batch(mds))
+    for _ in range(5):
+        s = float(net.fit_batch(mds))
+    assert np.isfinite(s) and s < s0
+
+
+def test_resnet50_constructs():
+    # full 50-layer DAG builds + topologically sorts (training is exercised
+    # at tiny size via resnet18; the 224 config is the bench's job)
+    net = zoo.resnet50(image_size=64, n_classes=10)
+    # 16 bottleneck blocks x 3 convs + 4 projections + stem = 53 convs
+    conv_names = [n for n in net.conf.vertices if n.endswith("_conv")]
+    assert len(conv_names) == 53
+    assert len(net.conf.topological_order()) == len(net.conf.vertices)
+
+
+def test_char_rnn_builds_and_trains():
+    net = zoo.char_rnn(vocab_size=16, hidden=24, n_layers=2, dtype=F32)
+    rng = np.random.default_rng(0)
+    x = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 12))]
+    y = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 12))]
+    s0 = float(net.fit_batch(DataSet(x, y)))
+    for _ in range(10):
+        s = float(net.fit_batch(DataSet(x, y)))
+    assert np.isfinite(s) and s < s0
+
+
+def test_fit_batch_repeated_matches_stepwise():
+    """n fit_batch calls == one fit_batch_repeated(n) (same rng stream
+    folding, same updates) — the scanned path must be semantically
+    identical to the dispatch-per-step path."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+    ds = DataSet(x, y)
+
+    a = zoo.lenet(seed=7, dtype=F32)
+    b = zoo.lenet(seed=7, dtype=F32)
+    for _ in range(4):
+        a.fit_batch(ds)
+    b.fit_batch_repeated(ds, 4)
+
+    assert a.iteration == b.iteration == 4
+    la = jax.tree_util.tree_leaves(a.params)
+    lb = jax.tree_util.tree_leaves(b.params)
+    for pa, pb in zip(la, lb):
+        # identical batch + deterministic init; rng streams differ (split
+        # sequence), but no dropout here so updates must match exactly
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fit_batch_repeated_graph():
+    from deeplearning4j_tpu.nn.updater import Adam
+    net = zoo.resnet18(dtype=F32, updater=Adam(1e-3))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    mds = MultiDataSet([x], [y])
+    s0 = float(net.fit_batch(mds))
+    s = float(net.fit_batch_repeated(mds, 5))
+    assert np.isfinite(s) and s < s0
+    assert net.iteration == 6
