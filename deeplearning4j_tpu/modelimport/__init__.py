@@ -1,0 +1,20 @@
+"""Keras HDF5 model import (parity: deeplearning4j-modelimport, 5,405 LoC
+— KerasModelImport.java:48-231 entry points, KerasModel.java config
+translation, KerasLayer.java weight-layout permutations, Hdf5Archive.java
+HDF5 reading)."""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    KerasImportError,
+    import_keras_model,
+    import_keras_model_and_weights,
+    import_keras_sequential_model,
+    import_keras_sequential_model_and_weights,
+)
+
+__all__ = [
+    "KerasImportError",
+    "import_keras_model",
+    "import_keras_model_and_weights",
+    "import_keras_sequential_model",
+    "import_keras_sequential_model_and_weights",
+]

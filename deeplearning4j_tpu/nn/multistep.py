@@ -1,0 +1,43 @@
+"""Shared machinery for running n fused train steps in one XLA execution
+(lax.scan over a network's raw step_fn) — used by both MultiLayerNetwork
+and ComputationGraph fit_batch_repeated."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def build_multi_step(step_fn, n_steps: int):
+    """jit(scan(step_fn, length=n_steps)). The returned callable has the
+    same signature as step_fn; the rng argument is split once per inner
+    step, and the returned score is the last step's."""
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+
+    def multi(params, state, opt_state, it0, *data_args):
+        rng = data_args[-1]
+        rest = data_args[:-1]
+
+        def body(carry, i):
+            p, s, o, key = carry
+            key, sub = jax.random.split(key)
+            p, s, o, score = step_fn(p, s, o, it0 + i, *rest, sub)
+            return (p, s, o, key), score
+
+        (p, s, o, _), scores = jax.lax.scan(
+            body, (params, state, opt_state, rng), jnp.arange(n_steps))
+        return p, s, o, scores[-1]
+
+    return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+
+def get_multi_step(net, n_steps: int):
+    """Cache-aware accessor for a network's scanned multi-step."""
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    jitted = net._multi_steps.get(n_steps)
+    if jitted is None:
+        jitted = build_multi_step(net._step_fn(), n_steps)
+        net._multi_steps[n_steps] = jitted
+    return jitted

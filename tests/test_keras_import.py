@@ -1,0 +1,390 @@
+"""Keras HDF5 import (KerasModelImport tests analogue).
+
+Fixture .h5 files are written directly in Keras's on-disk layout
+(model_config root attr + model_weights groups with weight_names), and
+imported models are verified numerically against an independent numpy
+forward implementation of Keras semantics (channels_last convs, i/f/c/o
+LSTM gates, etc.) — not against our own layers.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import (
+    KerasImportError,
+    import_keras_model_and_weights,
+    import_keras_sequential_model,
+    import_keras_sequential_model_and_weights,
+)
+
+
+# ------------------------------------------------------- fixture writing
+def write_keras_h5(path, model_config, layer_weights, keras_version="2.2.4",
+                   training_config=None):
+    """Write a Keras-layout .h5: model_config attr + model_weights group."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        f.attrs["keras_version"] = keras_version.encode()
+        f.attrs["backend"] = b"tensorflow"
+        if training_config is not None:
+            f.attrs["training_config"] = json.dumps(training_config).encode()
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [n.encode() for n in layer_weights], dtype="S64")
+        for lname, weights in layer_weights.items():
+            g = mw.create_group(lname)
+            names = [f"{lname}/w_{i}".encode() for i in range(len(weights))]
+            g.attrs["weight_names"] = np.array(names, dtype="S64")
+            for n, w in zip(names, weights):
+                g.create_dataset(n.decode(), data=np.asarray(w, np.float32))
+
+
+def seq_config(layers):
+    return {"class_name": "Sequential", "config": {"layers": layers}}
+
+
+# ------------------------------------------------- numpy keras reference
+def np_dense(x, W, b, act):
+    z = x @ W + b
+    return act(z)
+
+
+def np_relu(z):
+    return np.maximum(z, 0.0)
+
+
+def np_softmax(z):
+    e = np.exp(z - z.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def np_conv2d_valid(x, K, b):
+    """Naive channels_last 'valid' conv: x [b,h,w,cin], K [kh,kw,cin,cout]."""
+    bs, h, w, cin = x.shape
+    kh, kw, _, cout = K.shape
+    oh, ow = h - kh + 1, w - kw + 1
+    out = np.zeros((bs, oh, ow, cout))
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i:i + kh, j:j + kw, :]          # [b,kh,kw,cin]
+            out[:, i, j, :] = np.tensordot(patch, K, axes=([1, 2, 3],
+                                                           [0, 1, 2]))
+    return out + b
+
+
+def np_maxpool2(x):
+    b, h, w, c = x.shape
+    return x.reshape(b, h // 2, 2, w // 2, 2, c).max(axis=(2, 4))
+
+
+def np_lstm(x, kernel, recurrent, bias, units):
+    """Keras-semantics LSTM (gates i,f,c,o; sigmoid gates, tanh cell),
+    return_sequences."""
+    b, t, _ = x.shape
+    h = np.zeros((b, units))
+    c = np.zeros((b, units))
+    ys = []
+
+    def sig(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    for step in range(t):
+        z = x[:, step, :] @ kernel + h @ recurrent + bias
+        zi, zf, zc, zo = np.split(z, 4, axis=1)
+        i = sig(zi)
+        f = sig(zf)
+        g = np.tanh(zc)
+        o = sig(zo)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys, axis=1)
+
+
+# ----------------------------------------------------------------- tests
+def test_sequential_mlp_forward_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    W1, b1 = rng.normal(size=(5, 8)), rng.normal(size=(8,))
+    W2, b2 = rng.normal(size=(8, 3)), rng.normal(size=(3,))
+    config = seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 8, "activation": "relu",
+                    "batch_input_shape": [None, 5]}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "units": 3, "activation": "softmax"}},
+    ])
+    path = os.path.join(tmp_path, "mlp.h5")
+    write_keras_h5(path, config, {"d1": [W1, b1], "d2": [W2, b2]},
+                   training_config={"loss": "categorical_crossentropy"})
+
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    ref = np_dense(np_dense(x, W1, b1, np_relu), W2, b2, np_softmax)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    # imported as a trainable net: Output layer with the configured loss
+    assert net.conf.layers[-1].loss == "mcxent"
+
+
+def test_sequential_cnn_forward_matches_numpy(tmp_path):
+    rng = np.random.default_rng(1)
+    K1 = rng.normal(size=(3, 3, 2, 4))
+    b1 = rng.normal(size=(4,))
+    Wd = rng.normal(size=(3 * 3 * 4, 5))   # after pool: 6x6 -> (6-?)...
+    config = seq_config([
+        {"class_name": "Conv2D",
+         "config": {"name": "c1", "filters": 4, "kernel_size": [3, 3],
+                    "strides": [1, 1], "padding": "valid",
+                    "activation": "relu", "data_format": "channels_last",
+                    "batch_input_shape": [None, 8, 8, 2]}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "p1", "pool_size": [2, 2], "strides": [2, 2],
+                    "padding": "valid"}},
+        {"class_name": "Flatten", "config": {"name": "f1"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 5, "activation": "softmax"}},
+    ])
+    bd = rng.normal(size=(5,))
+    path = os.path.join(tmp_path, "cnn.h5")
+    write_keras_h5(path, config, {"c1": [K1, b1], "d1": [Wd, bd]})
+
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+
+    conv = np_relu(np_conv2d_valid(x, K1, b1))     # [2,6,6,4]
+    pooled = np_maxpool2(conv)                     # [2,3,3,4]
+    flat = pooled.reshape(2, -1)
+    ref = np_dense(flat, Wd, bd, np_softmax)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_channels_first_conv_and_dense_permutation(tmp_path):
+    """Theano-ordered kernels (O,I,kh,kw) + channels_first Flatten: the
+    import must permute so the NHWC forward matches the channels_last
+    import of the same logical model."""
+    rng = np.random.default_rng(2)
+    K = rng.normal(size=(3, 3, 2, 4))              # HWIO ground truth
+    b = rng.normal(size=(4,))
+    Wd = rng.normal(size=(3 * 3 * 4, 5))           # rows in (h, w, c) order
+    bd = rng.normal(size=(5,))
+
+    # channels_last file (ground truth)
+    cl = seq_config([
+        {"class_name": "Conv2D",
+         "config": {"name": "c1", "filters": 4, "kernel_size": [3, 3],
+                    "padding": "valid", "activation": "relu",
+                    "data_format": "channels_last",
+                    "batch_input_shape": [None, 8, 8, 2]}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "p1", "pool_size": [2, 2]}},
+        {"class_name": "Flatten", "config": {"name": "f1"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 5, "activation": "softmax"}},
+    ])
+    p_cl = os.path.join(tmp_path, "cl.h5")
+    write_keras_h5(p_cl, cl, {"c1": [K, b], "d1": [Wd, bd]})
+
+    # channels_first file: kernel (O,I,kh,kw); dense rows in (c,h,w) order
+    K_cf = K.transpose(3, 2, 0, 1)
+    perm = np.arange(3 * 3 * 4).reshape(3, 3, 4).transpose(2, 0, 1).reshape(-1)
+    Wd_cf = Wd[perm]            # W_cf rows indexed by (c,h,w) flatten
+    cf = seq_config([
+        {"class_name": "Conv2D",
+         "config": {"name": "c1", "filters": 4, "kernel_size": [3, 3],
+                    "padding": "valid", "activation": "relu",
+                    "data_format": "channels_first",
+                    "batch_input_shape": [None, 2, 8, 8]}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "p1", "pool_size": [2, 2],
+                    "data_format": "channels_first"}},
+        {"class_name": "Flatten",
+         "config": {"name": "f1", "data_format": "channels_first"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 5, "activation": "softmax"}},
+    ])
+    p_cf = os.path.join(tmp_path, "cf.h5")
+    write_keras_h5(p_cf, cf, {"c1": [K_cf, b], "d1": [Wd_cf, bd]})
+
+    net_cl = import_keras_sequential_model(p_cl)
+    net_cf = import_keras_sequential_model(p_cf)
+    x = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)  # NHWC input
+    np.testing.assert_allclose(np.asarray(net_cl.output(x)),
+                               np.asarray(net_cf.output(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_keras2_forward_matches_numpy(tmp_path):
+    rng = np.random.default_rng(3)
+    units, feats = 6, 4
+    kernel = rng.normal(size=(feats, 4 * units))
+    recurrent = rng.normal(size=(units, 4 * units))
+    bias = rng.normal(size=(4 * units,))
+    Wd = rng.normal(size=(units, 3))
+    bd = rng.normal(size=(3,))
+    config = seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "l1", "units": units, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": False,
+                    "batch_input_shape": [None, 5, feats]}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 3, "activation": "softmax"}},
+    ])
+    path = os.path.join(tmp_path, "lstm.h5")
+    write_keras_h5(path, config,
+                   {"l1": [kernel, recurrent, bias], "d1": [Wd, bd]})
+
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(2, 5, feats)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    seq = np_lstm(x, kernel, recurrent, bias, units)
+    ref = np_dense(seq[:, -1, :], Wd, bd, np_softmax)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_keras1_split_weights(tmp_path):
+    """Keras 1.x stores 12 per-gate arrays (W_i, U_i, b_i, W_c, ...) and
+    uses output_dim/inner_activation config keys."""
+    rng = np.random.default_rng(4)
+    units, feats = 5, 3
+    kernel = rng.normal(size=(feats, 4 * units))      # i,f,c,o blocks
+    recurrent = rng.normal(size=(units, 4 * units))
+    bias = rng.normal(size=(4 * units,))
+    Wi, Wf, Wc, Wo = np.split(kernel, 4, axis=1)
+    Ui, Uf, Uc, Uo = np.split(recurrent, 4, axis=1)
+    bi, bf, bc, bo = np.split(bias, 4)
+    config = seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "l1", "output_dim": units, "activation": "tanh",
+                    "inner_activation": "sigmoid", "return_sequences": True,
+                    "batch_input_shape": [None, 4, feats]}},
+    ])
+    path = os.path.join(tmp_path, "lstm1.h5")
+    write_keras_h5(path, config,
+                   {"l1": [Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo]},
+                   keras_version="1.2.2")
+
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(2, 4, feats)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    ref = np_lstm(x, kernel, recurrent, bias, units)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_inference_uses_moving_stats(tmp_path):
+    rng = np.random.default_rng(5)
+    gamma = rng.normal(size=(6,)) + 1.0
+    beta = rng.normal(size=(6,))
+    mean = rng.normal(size=(6,))
+    var = rng.uniform(0.5, 2.0, size=(6,))
+    W, b = rng.normal(size=(6, 2)), rng.normal(size=(2,))
+    config = seq_config([
+        {"class_name": "BatchNormalization",
+         "config": {"name": "bn", "epsilon": 1e-3, "momentum": 0.99,
+                    "batch_input_shape": [None, 6]}},
+        {"class_name": "Dense",
+         "config": {"name": "d", "units": 2, "activation": "linear"}},
+    ])
+    path = os.path.join(tmp_path, "bn.h5")
+    write_keras_h5(path, config, {"bn": [gamma, beta, mean, var],
+                                  "d": [W, b]})
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    ref = (gamma * (x - mean) / np.sqrt(var + 1e-3) + beta) @ W + b
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_two_branch_model(tmp_path):
+    """Functional API: two inputs -> dense each -> concatenate -> dense."""
+    rng = np.random.default_rng(6)
+    Wa, ba = rng.normal(size=(3, 4)), rng.normal(size=(4,))
+    Wb, bb = rng.normal(size=(2, 4)), rng.normal(size=(4,))
+    Wo, bo = rng.normal(size=(8, 2)), rng.normal(size=(2,))
+    config = {
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in_a",
+                 "config": {"name": "in_a",
+                            "batch_input_shape": [None, 3]},
+                 "inbound_nodes": []},
+                {"class_name": "InputLayer", "name": "in_b",
+                 "config": {"name": "in_b",
+                            "batch_input_shape": [None, 2]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "da",
+                 "config": {"name": "da", "units": 4, "activation": "relu"},
+                 "inbound_nodes": [[["in_a", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "db",
+                 "config": {"name": "db", "units": 4, "activation": "relu"},
+                 "inbound_nodes": [[["in_b", 0, 0, {}]]]},
+                {"class_name": "Concatenate", "name": "cat",
+                 "config": {"name": "cat"},
+                 "inbound_nodes": [[["da", 0, 0, {}], ["db", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "units": 2,
+                            "activation": "softmax"},
+                 "inbound_nodes": [[["cat", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in_a", 0, 0], ["in_b", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+    }
+    net = import_keras_model_and_weights(
+        config, {"da": [Wa, ba], "db": [Wb, bb], "out": [Wo, bo]})
+    xa = rng.normal(size=(4, 3)).astype(np.float32)
+    xb = rng.normal(size=(4, 2)).astype(np.float32)
+    ours = np.asarray(net.output(xa, xb))
+    ha = np_relu(xa @ Wa + ba)
+    hb = np_relu(xb @ Wb + bb)
+    ref = np_softmax(np.concatenate([ha, hb], axis=1) @ Wo + bo)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_imported_model_is_trainable(tmp_path):
+    rng = np.random.default_rng(7)
+    config = seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 16, "activation": "relu",
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "units": 3, "activation": "softmax"}},
+    ])
+    path = os.path.join(tmp_path, "train.h5")
+    write_keras_h5(path, config,
+                   {"d1": [rng.normal(size=(4, 16)), np.zeros(16)],
+                    "d2": [rng.normal(size=(16, 3)), np.zeros(3)]})
+    net = import_keras_sequential_model(path)
+    from deeplearning4j_tpu.datasets import DataSet
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    s0 = float(net.fit_batch(DataSet(x, y)))
+    for _ in range(20):
+        s = float(net.fit_batch(DataSet(x, y)))
+    assert s < s0
+
+
+def test_unsupported_layer_raises():
+    config = seq_config([
+        {"class_name": "Lambda", "config": {"name": "lam"}}])
+    with pytest.raises(KerasImportError, match="Lambda"):
+        import_keras_sequential_model_and_weights(config, {})
+
+
+def test_wrong_shape_raises(tmp_path):
+    rng = np.random.default_rng(8)
+    config = seq_config([
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 8, "activation": "relu",
+                    "batch_input_shape": [None, 5]}},
+    ])
+    with pytest.raises(KerasImportError, match="shape"):
+        import_keras_sequential_model_and_weights(
+            config, {"d1": [rng.normal(size=(4, 8)), np.zeros(8)]})
