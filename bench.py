@@ -12,13 +12,16 @@ training step the way the reference's cuDNN-path benchmarks do):
 - resnet50    bf16 batch 256  (baseline #2, the north-star: img/sec/chip + MFU)
 - char_rnn    bf16 batch 32 x seq 64 (baseline #3, LSTM scan)
 
-Timing is slope-based: run two window sizes via ``fit_batch_repeated``
-(n steps fused into ONE XLA execution by lax.scan — removes per-step host
-dispatch), each window ended by a device->host scalar read (the only
-reliable execution barrier through a remote-TPU tunnel, where
-block_until_ready can return before the queue drains), and take
-(t_large - t_small) / (n_large - n_small). This cancels the fixed
-barrier/dispatch cost and reports honest steady-state device step time.
+Timing: ``fit_batch_repeated`` fuses n steps into ONE XLA execution by
+lax.scan (removes per-step host dispatch); each window is ended by a
+device->host scalar read (the only reliable execution barrier through a
+remote-TPU tunnel, where block_until_ready can return before the queue
+drains). The window n is GROWN until one window takes >= 150 ms of wall
+time, then step time = min over 3 repeat windows of (window / n). The
+single dispatch+barrier overhead (~1 ms) is amortized below 1%, and the
+result can only overestimate step time — never the round-2 failure mode
+where a sub-resolution slope printed 0.0 ms / MFU > 1. A guard refuses to
+report MFU outside (0, 1].
 
 MFU = measured FLOP/s / peak FLOP/s, with per-step FLOPs taken from XLA's
 own cost model (jit(...).lower(...).compile().cost_analysis()['flops'])
@@ -52,6 +55,10 @@ def _peak_flops(device) -> float | None:
     return None
 
 
+_MIN_WINDOW_S = 0.15
+_REPEATS = 3
+
+
 def _bench_net(net, features, labels, *, scan_len=20, is_graph: bool):
     """Warm up, time fit_batch with device-resident data, and pull per-step
     FLOPs from the compiled step's cost analysis."""
@@ -67,21 +74,22 @@ def _bench_net(net, features, labels, *, scan_len=20, is_graph: bool):
     net.fit_batch(ds)  # compile the single step (also used for FLOP count)
     float(net.score_value)
 
-    n = scan_len
-
-    def window(k):
-        """k back-to-back scan executions, one host-read barrier at the
-        end; returns wall time."""
+    def window(n):
+        """One scanned n-step execution with a host-read barrier; wall time."""
         t0 = time.perf_counter()
-        for _ in range(k):
-            net.fit_batch_repeated(ds, n)
+        net.fit_batch_repeated(ds, n)
         float(net.score_value)
         return time.perf_counter() - t0
 
-    window(1)  # compile the scanned step, absorb stragglers
-    t1 = window(1)
-    t3 = window(3)
-    sec_per_step = max((t3 - t1) / (2 * n), 1e-9)
+    # grow the window until it is comfortably above timer/dispatch noise
+    n = scan_len
+    window(n)  # compile the scanned step, absorb stragglers
+    while True:
+        dt = window(n)
+        if dt >= _MIN_WINDOW_S or n >= 50000:
+            break
+        n = max(n * 2, int(n * _MIN_WINDOW_S / max(dt, 1e-3) * 1.3))
+    sec_per_step = min(window(n) for _ in range(_REPEATS)) / n
 
     flops = None
     try:
@@ -106,12 +114,19 @@ def _bench_net(net, features, labels, *, scan_len=20, is_graph: bool):
         "step_ms": round(1000.0 * sec_per_step, 3),
         "examples_per_sec": round(batch / sec_per_step, 1),
         "batch": batch,
+        "timing_window_steps": n,
     }
     peak = _peak_flops(jax.devices()[0])
     if flops is not None:
         out["step_gflops"] = round(flops / 1e9, 2)
         if peak:
-            out["mfu"] = round(flops / sec_per_step / peak, 4)
+            mfu = flops / sec_per_step / peak
+            if 0.0 < mfu <= 1.0:
+                out["mfu"] = round(mfu, 4)
+            else:
+                # a physically impossible MFU means the timing or the cost
+                # model is broken — refuse to publish it
+                out["mfu_invalid"] = round(mfu, 4)
     return out
 
 
@@ -165,8 +180,10 @@ def main():
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": primary["examples_per_sec"],
         "unit": "images/sec/chip",
-        # BASELINE.md bar: >=40% MFU (reference publishes no numbers)
-        "vs_baseline": round(mfu / 0.40, 3) if mfu else 1.0,
+        # BASELINE.md bar: >=40% MFU (reference publishes no numbers).
+        # vs_baseline = achieved/0.40; 0.0 when MFU could not be measured
+        # honestly (never fabricate parity).
+        "vs_baseline": round(mfu / 0.40, 3) if mfu else 0.0,
         "extra": results,
     }))
 

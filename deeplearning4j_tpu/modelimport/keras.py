@@ -186,14 +186,21 @@ def _dense_loader(ctx_flatten_cf):
     return load
 
 
-def _conv_loader(channels_first):
+def _conv_loader(theano_kernel):
+    """Kernel layout conversion (KerasConvolution.java:108-137 parity).
+
+    Keras 2 stores conv kernels HWIO regardless of data_format — ours is
+    HWIO, so no transform. Keras 1 'tf' dim ordering is also HWIO. Keras 1
+    'th' (Theano) kernels are (out, in, kh, kw) AND Theano rotates filters
+    180 degrees before application (KerasConvolution.java:124-137), so the
+    spatial window is flipped then transposed to HWIO."""
     def load(net, name, arrays):
         if not arrays:
             return
         K = np.asarray(arrays[0])
-        if channels_first:
-            # (out, in, kh, kw) -> (kh, kw, in, out)
-            K = K.transpose(2, 3, 1, 0)
+        if theano_kernel:
+            # (out, in, kh, kw): rotate each filter 180deg, then -> HWIO
+            K = K[:, :, ::-1, ::-1].transpose(2, 3, 1, 0)
         kw = {"W": K}
         if len(arrays) > 1:
             kw["b"] = np.asarray(arrays[1])
@@ -345,6 +352,10 @@ def _translate_layer(class_name: str, cfg: dict, ctx: _Ctx, *,
 
     if class_name in ("Conv2D", "Convolution2D"):
         cf = _channels_first(cfg, ctx.channels_first)
+        # Keras-1-only config keys identify a Keras 1 file; only Keras 1
+        # Theano-ordered kernels need a layout transform (see _conv_loader)
+        keras1 = "nb_filter" in cfg or "dim_ordering" in cfg
+        theano_kernel = keras1 and cf
         n_out = int(cfg.get("filters", cfg.get("nb_filter")))
         if "kernel_size" in cfg:
             kh, kw = _pair(cfg["kernel_size"])
@@ -358,7 +369,7 @@ def _translate_layer(class_name: str, cfg: dict, ctx: _Ctx, *,
                              stride=(sh, sw), mode=mode, activation=act,
                              has_bias=use_bias)
         _update_shape_conv(ctx, kh, kw, sh, sw, mode, n_out)
-        out.append(_Translated(conf, name, _conv_loader(cf)))
+        out.append(_Translated(conf, name, _conv_loader(theano_kernel)))
         return out
 
     if class_name in ("MaxPooling2D", "AveragePooling2D"):
@@ -572,11 +583,17 @@ def import_keras_model_and_weights(
     ctx = _Ctx(loss=training_loss)
     input_types = []
     loaders: List[Tuple[str, str, Callable]] = []
+    # keras layer name -> final translated vertex name: when a layer's
+    # translation ends in an extra vertex (e.g. LSTM with
+    # return_sequences=False appends a LastTimeStep), later layers and
+    # set_outputs must resolve the Keras name to that LAST vertex, not the
+    # intermediate one (otherwise the full-sequence output leaks through)
+    alias: Dict[str, str] = {}
     for ld in layer_dicts:
         class_name = ld["class_name"]
         cfg = dict(ld.get("config", {}))
         name = cfg.get("name", ld.get("name"))
-        inputs = _inbound_names(ld)
+        inputs = [alias.get(i, i) for i in _inbound_names(ld)]
 
         if class_name == "InputLayer":
             shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
@@ -626,11 +643,12 @@ def import_keras_model_and_weights(
             if t.loader is not None:
                 loaders.append((t.keras_name, t.conf.name, t.loader))
             prev = [t.conf.name]
+        if prev and prev[0] != name:
+            alias[name] = prev[0]
 
-    # outputs may have been renamed by trailing LastTimeStep insertion;
-    # they keep the keras layer name, so set_outputs uses out_names order
-    g.set_outputs(*[e[0] if isinstance(e, (list, tuple)) else e
-                    for e in extras["output_layers"]])
+    g.set_outputs(*[alias.get(n, n) for n in
+                    (e[0] if isinstance(e, (list, tuple)) else e
+                     for e in extras["output_layers"])])
     if input_types:
         g.set_input_types(*input_types)
     net = ComputationGraph(g.build()).init()

@@ -46,7 +46,9 @@ class ConvolutionLayer(Layer):
             strides=(sh, sw), padding=pads, dilation=(dh, dw))
         if "b" in params:
             z = z + params["b"].astype(cd)
-        return self.activation_fn(z.astype(self.param_dtype)), state
+        # stay in compute dtype (bf16 activations end-to-end under the
+        # mixed policy — halves HBM traffic and residual memory)
+        return self.activation_fn(z), state
 
 
 class Convolution1DLayerImpl(Layer):
@@ -79,7 +81,7 @@ class Convolution1DLayerImpl(Layer):
             stride=c.stride, padding=pads, dilation=c.dilation)
         if "b" in params:
             z = z + params["b"].astype(cd)
-        return self.activation_fn(z.astype(self.param_dtype)), state
+        return self.activation_fn(z), state
 
 
 def _pool2d(x, *, kernel, strides, padding, pooling, pnorm):

@@ -36,11 +36,14 @@ class DenseLayer(Layer):
         return params
 
     def preout(self, params, x):
+        # Activations stay in compute dtype between layers (bf16 under the
+        # mixed policy) — HBM traffic and residuals are half-width; loss
+        # heads cast back up to param dtype (see OutputLayer.loss).
         cd = self.compute_dtype
         z = jnp.matmul(x.astype(cd), params["W"].astype(cd))
         if "b" in params:
             z = z + params["b"].astype(cd)
-        return z.astype(self.param_dtype)
+        return z
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         # 2d [batch, n_in]; time series are flattened by an rnn_to_ff
@@ -59,7 +62,8 @@ class OutputLayer(DenseLayer):
 
     def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
         x = self._input_dropout(x, train, rng)
-        z = self.preout(params, x)
+        # loss math (softmax/log) in param dtype (f32) for stability
+        z = self.preout(params, x).astype(self.param_dtype)
         return self.loss_fn.score(labels, z, self.activation_fn, mask)
 
 
@@ -77,7 +81,8 @@ class LossOnlyLayer(Layer):
         return self.activation_fn(x), state
 
     def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
-        return self.loss_fn.score(labels, x, self.activation_fn, mask)
+        return self.loss_fn.score(labels, x.astype(self.param_dtype),
+                                  self.activation_fn, mask)
 
 
 class ActivationOnlyLayer(Layer):

@@ -9,6 +9,7 @@ functionally inside the jitted train step, not mutated in place).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.layers.base import Layer
@@ -43,9 +44,10 @@ class BatchNormLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         c = self.conf
         axes = tuple(range(x.ndim - 1))  # all but the feature/channel axis
+        sd = self.param_dtype  # statistics accumulate at full precision
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x.astype(sd), axis=axes)
+            var = jnp.var(x.astype(sd), axis=axes)
             d = c.decay
             new_state = {
                 "mean": d * state["mean"] + (1 - d) * mean,
@@ -54,11 +56,14 @@ class BatchNormLayer(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = {}
-        xhat = (x - mean) / jnp.sqrt(var + c.eps)
+        # normalize in the activation dtype (bf16 under the mixed policy) —
+        # the per-channel scale/shift fuse into neighbouring ops
+        inv = jax.lax.rsqrt(var + c.eps)
         if params:
-            xhat = xhat * params["gamma"] + params["beta"]
+            scale, shift = params["gamma"] * inv, params["beta"] - mean * params["gamma"] * inv
         else:
-            xhat = xhat * c.gamma + c.beta
+            scale, shift = c.gamma * inv, c.beta - mean * c.gamma * inv
+        xhat = x * scale.astype(x.dtype) + shift.astype(x.dtype)
         return self.activation_fn(xhat), new_state
 
 

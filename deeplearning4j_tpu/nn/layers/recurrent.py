@@ -38,7 +38,12 @@ CARRY_KEYS = ("h", "c", "h_bwd", "c_bwd")
 
 
 def _lstm_scan(params, x, h0, c0, mask, gate_act, cell_act):
-    """Scan an LSTM over [b, t, f]; returns (y [b,t,n], hT, cT)."""
+    """Scan an LSTM over [b, t, f]; returns (y [b,t,n], hT, cT).
+
+    Runs entirely in x.dtype (the compute dtype — bf16 under the mixed
+    policy, so the recurrent matmul hits the MXU at full rate)."""
+    cd = x.dtype
+    params = {k: v.astype(cd) for k, v in params.items()}
     n = params["b"].shape[0] // 4
     p_i = params["p"][0]
     p_f = params["p"][1]
@@ -104,10 +109,10 @@ class GravesLSTMLayer(Layer):
         n = self.conf.n_out
         b = x.shape[0]
         if carry is None:
-            h0 = jnp.zeros((b, n), self.param_dtype)
-            c0 = jnp.zeros((b, n), self.param_dtype)
+            h0 = jnp.zeros((b, n), x.dtype)
+            c0 = jnp.zeros((b, n), x.dtype)
         else:
-            h0, c0 = carry
+            h0, c0 = (carry[0].astype(x.dtype), carry[1].astype(x.dtype))
         if reverse:
             x = jnp.flip(x, axis=1)
             mask = None if mask is None else jnp.flip(mask, axis=1)
@@ -118,7 +123,7 @@ class GravesLSTMLayer(Layer):
         return y, hT, cT
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        x = self._input_dropout(x, train, rng).astype(self.param_dtype)
+        x = self._input_dropout(x, train, rng).astype(self.compute_dtype)
         m = None
         if mask is not None:
             m = mask.reshape(mask.shape[0], -1).astype(x.dtype)
@@ -145,7 +150,7 @@ class GravesBidirectionalLSTMLayer(GravesLSTMLayer):
                 "rnnTimeStep/tBPTT streaming is undefined for bidirectional "
                 "LSTM (the backward pass needs the full sequence) — matching "
                 "the reference's restriction")
-        x = self._input_dropout(x, train, rng).astype(self.param_dtype)
+        x = self._input_dropout(x, train, rng).astype(self.compute_dtype)
         m = None
         if mask is not None:
             m = mask.reshape(mask.shape[0], -1).astype(x.dtype)
@@ -177,7 +182,7 @@ class RnnOutputLayerImpl(Layer):
         z = jnp.einsum("btf,fg->btg", x.astype(cd), params["W"].astype(cd))
         if "b" in params:
             z = z + params["b"].astype(cd)
-        return z.astype(self.param_dtype)
+        return z
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self._input_dropout(x, train, rng)
@@ -185,7 +190,8 @@ class RnnOutputLayerImpl(Layer):
 
     def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
         x = self._input_dropout(x, train, rng)
-        z = self.preout(params, x)
+        # loss math in param dtype (f32) for stability
+        z = self.preout(params, x).astype(self.param_dtype)
         n_out = z.shape[-1]
         z2 = z.reshape(-1, n_out)
         labels2 = labels.reshape(-1, n_out)

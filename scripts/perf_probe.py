@@ -1,0 +1,159 @@
+"""Perf probe: honest step timing + XLA cost breakdown for one bench config.
+
+Usage: python scripts/perf_probe.py resnet50 --batch 256 [--image 224]
+Prints a JSON line with step_ms (min-of-k, window>=min_ms), examples/sec,
+MFU from XLA cost analysis, and the top HLO categories from the compiled
+module's cost analysis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _peak_flops(device) -> float | None:
+    peaks = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
+             "TPU v4": 275e12, "TPU v6": 918e12}
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in peaks.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def time_net(net, ds, *, is_graph, min_window_s=0.2, repeats=3, scan0=10):
+    import jax
+
+    net.fit_batch(ds)
+    float(net.score_value)
+
+    n = scan0
+    while True:
+        t0 = time.perf_counter()
+        net.fit_batch_repeated(ds, n)
+        float(net.score_value)
+        dt = time.perf_counter() - t0
+        if dt >= min_window_s:
+            break
+        # grow (first call at each n pays compile; re-time below)
+        n = max(n * 2, int(n * (min_window_s / max(dt, 1e-3)) * 1.3))
+        if n > 20000:
+            break
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        net.fit_batch_repeated(ds, n)
+        float(net.score_value)
+        times.append(time.perf_counter() - t0)
+    sec_per_step = min(times) / n
+    return sec_per_step, n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", choices=["resnet50", "lenet", "char_rnn",
+                                       "mnist_mlp", "resnet18"])
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--f32", action="store_true")
+    ap.add_argument("--breakdown", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import zoo
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+    rng = np.random.default_rng(0)
+    dtype = zoo.F32 if args.f32 else None
+    is_graph = False
+
+    if args.config == "resnet50":
+        net = zoo.resnet50(image_size=args.image, dtype=dtype)
+        x = rng.normal(size=(args.batch, args.image, args.image, 3)).astype(np.float32)
+        y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, args.batch)]
+        is_graph = True
+    elif args.config == "resnet18":
+        net = zoo.resnet18(image_size=args.image, dtype=dtype)
+        x = rng.normal(size=(args.batch, args.image, args.image, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, args.batch)]
+        is_graph = True
+    elif args.config == "lenet":
+        net = zoo.lenet(dtype=dtype)
+        x = rng.normal(size=(args.batch, 28, 28, 1)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, args.batch)]
+    elif args.config == "mnist_mlp":
+        net = zoo.mnist_mlp(dtype=dtype)
+        x = rng.normal(size=(args.batch, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, args.batch)]
+    else:
+        net = zoo.char_rnn(vocab_size=80, hidden=args.hidden, n_layers=2,
+                           dtype=dtype)
+        ids = rng.integers(0, 80, (args.batch, args.seq))
+        x = np.eye(80, dtype=np.float32)[ids]
+        y = np.eye(80, dtype=np.float32)[rng.integers(0, 80, (args.batch, args.seq))]
+
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    ds = MultiDataSet([xd], [yd]) if is_graph else DataSet(xd, yd)
+
+    t0 = time.perf_counter()
+    sec_per_step, n = time_net(net, ds, is_graph=is_graph)
+    total = time.perf_counter() - t0
+
+    out = {
+        "config": args.config,
+        "batch": args.batch,
+        "step_ms": round(1000 * sec_per_step, 3),
+        "examples_per_sec": round(args.batch / sec_per_step, 1),
+        "scan_len": n,
+        "bench_wall_s": round(total, 1),
+    }
+
+    # cost analysis of the single fused step
+    try:
+        it = jnp.asarray(0, jnp.int32)
+        k = jax.random.PRNGKey(0)
+        if is_graph:
+            sargs = (net.params, net.state, net.opt_state, it,
+                     {net.conf.network_inputs[0]: xd}, [yd], {}, None, k)
+        else:
+            sargs = (net.params, net.state, net.opt_state, it, xd, yd,
+                     None, None, k)
+        compiled = net._train_step.lower(*sargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        bytes_ = float(cost.get("bytes accessed", 0.0))
+        out["step_gflops"] = round(flops / 1e9, 2)
+        out["step_gbytes"] = round(bytes_ / 1e9, 3)
+        peak = _peak_flops(jax.devices()[0])
+        if peak and sec_per_step > 0:
+            out["mfu"] = round(flops / sec_per_step / peak, 4)
+            out["achieved_tflops"] = round(flops / sec_per_step / 1e12, 1)
+            out["hbm_gb_per_s"] = round(bytes_ / sec_per_step / 1e9, 1)
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["peak_mem_gb"] = round(
+                (getattr(mem, "temp_size_in_bytes", 0)
+                 + getattr(mem, "argument_size_in_bytes", 0)
+                 + getattr(mem, "output_size_in_bytes", 0)) / 1e9, 2)
+    except Exception as e:
+        out["cost_error"] = repr(e)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

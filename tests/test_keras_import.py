@@ -165,9 +165,10 @@ def test_sequential_cnn_forward_matches_numpy(tmp_path):
 
 
 def test_channels_first_conv_and_dense_permutation(tmp_path):
-    """Theano-ordered kernels (O,I,kh,kw) + channels_first Flatten: the
-    import must permute so the NHWC forward matches the channels_last
-    import of the same logical model."""
+    """Keras 2 channels_first: kernels are stored HWIO regardless of
+    data_format (only the post-Flatten Dense rows are (c,h,w)-ordered), so
+    the NHWC forward must match the channels_last import of the same
+    logical model (KerasConvolution.java:108-137 parity as corrected)."""
     rng = np.random.default_rng(2)
     K = rng.normal(size=(3, 3, 2, 4))              # HWIO ground truth
     b = rng.normal(size=(4,))
@@ -190,8 +191,7 @@ def test_channels_first_conv_and_dense_permutation(tmp_path):
     p_cl = os.path.join(tmp_path, "cl.h5")
     write_keras_h5(p_cl, cl, {"c1": [K, b], "d1": [Wd, bd]})
 
-    # channels_first file: kernel (O,I,kh,kw); dense rows in (c,h,w) order
-    K_cf = K.transpose(3, 2, 0, 1)
+    # Keras 2 channels_first file: kernel STAYS HWIO; dense rows (c,h,w)
     perm = np.arange(3 * 3 * 4).reshape(3, 3, 4).transpose(2, 0, 1).reshape(-1)
     Wd_cf = Wd[perm]            # W_cf rows indexed by (c,h,w) flatten
     cf = seq_config([
@@ -209,13 +209,64 @@ def test_channels_first_conv_and_dense_permutation(tmp_path):
          "config": {"name": "d1", "units": 5, "activation": "softmax"}},
     ])
     p_cf = os.path.join(tmp_path, "cf.h5")
-    write_keras_h5(p_cf, cf, {"c1": [K_cf, b], "d1": [Wd_cf, bd]})
+    write_keras_h5(p_cf, cf, {"c1": [K, b], "d1": [Wd_cf, bd]})
 
     net_cl = import_keras_sequential_model(p_cl)
     net_cf = import_keras_sequential_model(p_cf)
     x = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)  # NHWC input
     np.testing.assert_allclose(np.asarray(net_cl.output(x)),
                                np.asarray(net_cf.output(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras1_theano_kernel_flip(tmp_path):
+    """Keras 1 'th' dim ordering: kernels are (O,I,kh,kw) with Theano's
+    180-degree filter rotation baked in (KerasConvolution.java:124-137) —
+    the import must un-rotate + transpose to HWIO, and permute the
+    post-Flatten Dense rows from (c,h,w) order."""
+    rng = np.random.default_rng(7)
+    K = rng.normal(size=(3, 3, 2, 4))              # HWIO ground truth
+    b = rng.normal(size=(4,))
+    Wd = rng.normal(size=(3 * 3 * 4, 5))
+    bd = rng.normal(size=(5,))
+
+    cl = seq_config([
+        {"class_name": "Conv2D",
+         "config": {"name": "c1", "filters": 4, "kernel_size": [3, 3],
+                    "padding": "valid", "activation": "relu",
+                    "data_format": "channels_last",
+                    "batch_input_shape": [None, 5, 5, 2]}},
+        {"class_name": "Flatten", "config": {"name": "f1"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 5, "activation": "softmax"}},
+    ])
+    p_cl = os.path.join(tmp_path, "cl.h5")
+    write_keras_h5(p_cl, cl, {"c1": [K, b], "d1": [Wd, bd]})
+
+    # Keras 1 theano file: (O,I,kh,kw) + spatial 180deg rotation
+    K_th = K.transpose(3, 2, 0, 1)[:, :, ::-1, ::-1]
+    perm = np.arange(3 * 3 * 4).reshape(3, 3, 4).transpose(2, 0, 1).reshape(-1)
+    Wd_cf = Wd[perm]
+    th = seq_config([
+        {"class_name": "Convolution2D",
+         "config": {"name": "c1", "nb_filter": 4, "nb_row": 3, "nb_col": 3,
+                    "border_mode": "valid", "activation": "relu",
+                    "dim_ordering": "th",
+                    "batch_input_shape": [None, 2, 5, 5]}},
+        {"class_name": "Flatten",
+         "config": {"name": "f1", "dim_ordering": "th"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "output_dim": 5, "activation": "softmax"}},
+    ])
+    p_th = os.path.join(tmp_path, "th.h5")
+    write_keras_h5(p_th, th, {"c1": [K_th, b], "d1": [Wd_cf, bd]},
+                   keras_version="1.2.2")
+
+    net_cl = import_keras_sequential_model(p_cl)
+    net_th = import_keras_sequential_model(p_th)
+    x = rng.normal(size=(2, 5, 5, 2)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net_cl.output(x)),
+                               np.asarray(net_th.output(x)),
                                rtol=1e-4, atol=1e-5)
 
 
