@@ -59,6 +59,38 @@ _MIN_WINDOW_S = 0.15
 _REPEATS = 3
 
 
+def calibrated_step_time(net, ds, *, min_window_s=_MIN_WINDOW_S,
+                         repeats=_REPEATS, scan0=20, max_n=50000):
+    """Honest steady-state step time via ``fit_batch_repeated``.
+
+    Grows the scan window until one window takes >= ``min_window_s`` of
+    wall time, then returns ``(min over repeats of window/n, n)``.
+    fit_batch_repeated compiles a fresh scan per distinct n, so after each
+    growth the first window is a throwaway (pays compile) and only the
+    SECOND is timed — otherwise compile time satisfies the floor and the
+    loop exits with a sub-floor window (round-2 failure mode). Shared by
+    bench.py and scripts/perf_probe.py."""
+    net.fit_batch(ds)  # compile the single step
+    float(net.score_value)
+
+    def window(n):
+        """One scanned n-step execution with a host-read barrier; wall time."""
+        t0 = time.perf_counter()
+        net.fit_batch_repeated(ds, n)
+        float(net.score_value)
+        return time.perf_counter() - t0
+
+    n = scan0
+    window(n)  # compile the scanned step, absorb stragglers
+    while True:
+        dt = window(n)
+        if dt >= min_window_s or n >= max_n:
+            break
+        n = max(n * 2, int(n * min_window_s / max(dt, 1e-3) * 1.3))
+        window(n)  # throwaway: compile at the new n
+    return min(window(n) for _ in range(repeats)) / n, n
+
+
 def _bench_net(net, features, labels, *, scan_len=20, is_graph: bool):
     """Warm up, time fit_batch with device-resident data, and pull per-step
     FLOPs from the compiled step's cost analysis."""
@@ -71,25 +103,7 @@ def _bench_net(net, features, labels, *, scan_len=20, is_graph: bool):
     y = jnp.asarray(labels)
     ds = MultiDataSet([x], [y]) if is_graph else DataSet(x, y)
 
-    net.fit_batch(ds)  # compile the single step (also used for FLOP count)
-    float(net.score_value)
-
-    def window(n):
-        """One scanned n-step execution with a host-read barrier; wall time."""
-        t0 = time.perf_counter()
-        net.fit_batch_repeated(ds, n)
-        float(net.score_value)
-        return time.perf_counter() - t0
-
-    # grow the window until it is comfortably above timer/dispatch noise
-    n = scan_len
-    window(n)  # compile the scanned step, absorb stragglers
-    while True:
-        dt = window(n)
-        if dt >= _MIN_WINDOW_S or n >= 50000:
-            break
-        n = max(n * 2, int(n * _MIN_WINDOW_S / max(dt, 1e-3) * 1.3))
-    sec_per_step = min(window(n) for _ in range(_REPEATS)) / n
+    sec_per_step, n = calibrated_step_time(net, ds, scan0=scan_len)
 
     flops = None
     try:

@@ -284,6 +284,8 @@ class ComputationGraph:
         one host dispatch instead of n. See
         MultiLayerNetwork.fit_batch_repeated."""
         self._require_init()
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         mds = self._coerce(mds)
         if self._mesh is not None or self.conf.backprop_type == "tbptt":
             # meshed execution needs shard_step_multi's batch handling;
@@ -291,26 +293,8 @@ class ComputationGraph:
             for _ in range(n_steps):
                 score = self.fit_batch(mds)
             return score
-        jitted = self._multi_steps.get(n_steps)
-        if jitted is None:
-            step_fn = self._step_fn()
-
-            def multi(params, state, opt_state, it0, inputs, labels, fmasks,
-                      lmasks, rng):
-                def body(carry, i):
-                    p, s, o, key = carry
-                    key, sub = jax.random.split(key)
-                    p, s, o, score = step_fn(p, s, o, it0 + i, inputs,
-                                             labels, fmasks, lmasks, sub)
-                    return (p, s, o, key), score
-
-                (p, s, o, _), scores = jax.lax.scan(
-                    body, (params, state, opt_state, rng),
-                    jnp.arange(n_steps))
-                return p, s, o, scores[-1]
-
-            jitted = jax.jit(multi, donate_argnums=(0, 1, 2))
-            self._multi_steps[n_steps] = jitted
+        from deeplearning4j_tpu.nn.multistep import get_multi_step
+        jitted = get_multi_step(self, n_steps)
         self._rng_key, rng = jax.random.split(self._rng_key)
         inputs, fmasks = self._prepare_inputs(mds.features, mds.features_masks)
         labels = [jnp.asarray(l) for l in mds.labels]

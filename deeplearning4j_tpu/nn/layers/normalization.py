@@ -13,7 +13,10 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.ops import normalization as _bn  # registers the op
 from deeplearning4j_tpu.ops import registry as ops
+
+del _bn
 
 
 class BatchNormLayer(Layer):
@@ -43,27 +46,30 @@ class BatchNormLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         c = self.conf
-        axes = tuple(range(x.ndim - 1))  # all but the feature/channel axis
-        sd = self.param_dtype  # statistics accumulate at full precision
+        f = x.shape[-1]
+        if params:
+            gamma, beta = params["gamma"], params["beta"]
+        else:
+            gamma = jnp.full((f,), float(c.gamma), self.param_dtype)
+            beta = jnp.full((f,), float(c.beta), self.param_dtype)
         if train:
-            mean = jnp.mean(x.astype(sd), axis=axes)
-            var = jnp.var(x.astype(sd), axis=axes)
+            # custom-VJP op: single-pass f32 statistics, bf16-clean backward
+            # (see ops/normalization.py; CudnnBatchNormalizationHelper.java
+            # is the reference's fused-kernel analogue)
+            xhat, mean, var = ops.get("batch_norm_train")(
+                x, gamma, beta, eps=c.eps)
             d = c.decay
+            sd = self.param_dtype
             new_state = {
-                "mean": d * state["mean"] + (1 - d) * mean,
-                "var": d * state["var"] + (1 - d) * var,
+                "mean": d * state["mean"] + (1 - d) * mean.astype(sd),
+                "var": d * state["var"] + (1 - d) * var.astype(sd),
             }
         else:
             mean, var = state["mean"], state["var"]
             new_state = {}
-        # normalize in the activation dtype (bf16 under the mixed policy) —
-        # the per-channel scale/shift fuse into neighbouring ops
-        inv = jax.lax.rsqrt(var + c.eps)
-        if params:
-            scale, shift = params["gamma"] * inv, params["beta"] - mean * params["gamma"] * inv
-        else:
-            scale, shift = c.gamma * inv, c.beta - mean * c.gamma * inv
-        xhat = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+            inv = jax.lax.rsqrt(var + c.eps)
+            scale, shift = gamma * inv, beta - mean * gamma * inv
+            xhat = x * scale.astype(x.dtype) + shift.astype(x.dtype)
         return self.activation_fn(xhat), new_state
 
 

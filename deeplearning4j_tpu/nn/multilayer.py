@@ -270,6 +270,8 @@ class MultiLayerNetwork:
         Used by bench.py for device-true step timing and usable for
         training on a small device-resident dataset."""
         self._require_init()
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         needs_tbptt = (self.conf.backprop_type == "tbptt"
                        and getattr(ds.features, "ndim", 0) == 3
                        and ds.features.shape[1] > self.conf.tbptt_fwd_length)
@@ -280,26 +282,8 @@ class MultiLayerNetwork:
             for _ in range(n_steps):
                 score = self.fit_batch(ds)
             return score
-        jitted = self._multi_steps.get(n_steps)
-        if jitted is None:
-            step_fn = self._step_fn()
-
-            def multi(params, state, opt_state, it0, x, labels, fmask,
-                      lmask, rng):
-                def body(carry, i):
-                    p, s, o, key = carry
-                    key, sub = jax.random.split(key)
-                    p, s, o, score = step_fn(p, s, o, it0 + i, x, labels,
-                                             fmask, lmask, sub)
-                    return (p, s, o, key), score
-
-                (p, s, o, _), scores = jax.lax.scan(
-                    body, (params, state, opt_state, rng),
-                    jnp.arange(n_steps))
-                return p, s, o, scores[-1]
-
-            jitted = jax.jit(multi, donate_argnums=(0, 1, 2))
-            self._multi_steps[n_steps] = jitted
+        from deeplearning4j_tpu.nn.multistep import get_multi_step
+        jitted = get_multi_step(self, n_steps)
         self._rng_key, rng = jax.random.split(self._rng_key)
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)

@@ -1,0 +1,119 @@
+"""Batch-norm training op with a hand-written, dtype-controlled backward.
+
+Parity: the cuDNN batch-norm helper
+(deeplearning4j-cuda/.../CudnnBatchNormalizationHelper.java) — the reference
+routes BN through a fused native kernel for exactly the reason this op
+exists: the composed-op formulation is memory-bound and the autodiff
+backward is wasteful.
+
+Why a custom VJP: under the mixed bf16 policy, autodiff of
+``mean``/``var`` over ``x.astype(f32)`` pushes *f32 activation-sized
+cotangents* through the statistics path (measured: 96 f32[256,56,56,256]
+tensors in the ResNet-50 step HLO, collapsing the step to ~9-14 flops/byte
+on an HBM-bound roofline). The hand-written backward keeps every
+activation-sized tensor in the compute dtype (bf16) and accumulates the
+per-channel reductions in f32 — 4 activation reads + 1 write total:
+
+    pass 1 (one fused read of g, x):  a = sum(g),  b = sum(g * xhat)
+    pass 2 (one more read of g, x):   dx = gamma*inv * (g - a/N - xhat*b/N)
+
+Forward is single-pass: the mean and variance reductions are siblings XLA
+fuses into one read of x, using the shifted formulation
+var = E[(x-K)^2] - E[x-K]^2 (K = first-element channel mean) so the
+single pass stays numerically stable when |mean| >> std.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import registry
+
+
+def _acc_dtype(x):
+    """Accumulation dtype: at least f32, wider if x already is (f64 in the
+    x64 test suite, where gradient checks run at double precision)."""
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
+def _stats(x, axes):
+    """Single-pass per-channel mean / variance with full-precision accum.
+
+    Uses the shifted formulation var = E[(x-K)^2] - E[x-K]^2 with K = the
+    per-channel mean of the first batch element (a 1/B-cost extra read):
+    exact for any K, and K ~ mean kills the catastrophic cancellation the
+    naive E[x^2] - E[x]^2 suffers when |mean| >> std."""
+    xf = x.astype(_acc_dtype(x))
+    shift_axes = tuple(a for a in axes if a != 0)
+    k = jax.lax.stop_gradient(
+        jnp.mean(xf[0:1], axis=(0,) + shift_axes))
+    xs = xf - k
+    m1s = jnp.mean(xs, axis=axes)
+    m2s = jnp.mean(xs * xs, axis=axes)
+    var = jnp.maximum(m2s - m1s * m1s, 0.0)
+    return m1s + k, var
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def batch_norm_train(x, gamma, beta, eps):
+    """Normalize ``x`` over all-but-last axes with batch statistics.
+
+    Returns ``(y, mean, var)`` — mean/var are the f32 batch statistics the
+    caller folds into its running averages (they receive zero cotangents;
+    the running-statistics update is not differentiated, matching the
+    reference's BatchNormalization.java train path).
+    """
+    y, mean, var, _ = _bn_fwd_impl(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _bn_fwd_impl(x, gamma, beta, eps):
+    axes = tuple(range(x.ndim - 1))
+    m1, var = _stats(x, axes)
+    inv = jax.lax.rsqrt(var + eps)
+    ad = _acc_dtype(x)
+    scale = gamma.astype(ad) * inv
+    shift = beta.astype(ad) - m1 * scale
+    y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return y, m1, var, inv
+
+
+def _bn_fwd(x, gamma, beta, eps):
+    y, m1, var, inv = _bn_fwd_impl(x, gamma, beta, eps)
+    return (y, m1, var), (x, gamma, m1, inv)
+
+
+def _bn_bwd(eps, res, cts):
+    g = cts[0]  # cotangents for (mean, var) outputs are zero: stats feed
+    # only the (undifferentiated) running-average update
+    x, gamma, m1, inv = res
+    cd = x.dtype
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+
+    ad = _acc_dtype(x)
+    m1c = m1.astype(cd)
+    invc = inv.astype(cd)
+    xhat = (x - m1c) * invc                       # bf16, fused
+    a = jnp.sum(g.astype(ad), axis=axes)
+    b = jnp.sum((g * xhat).astype(ad), axis=axes)
+
+    scale = gamma.astype(ad) * inv
+    dx = scale.astype(cd) * (
+        g - (a / n).astype(cd) - xhat * (b / n).astype(cd))
+    dgamma = b.astype(gamma.dtype)
+    dbeta = a.astype(gamma.dtype)
+    return dx, dgamma, dbeta
+
+
+batch_norm_train.defvjp(_bn_fwd, _bn_bwd)
+
+
+@registry.register("batch_norm_train", backend="xla")
+def batch_norm_train_xla(x, gamma, beta, *, eps):
+    return batch_norm_train(x, gamma, beta, eps)

@@ -19,42 +19,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _peak_flops(device) -> float | None:
-    peaks = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
-             "TPU v4": 275e12, "TPU v6": 918e12}
-    kind = getattr(device, "device_kind", "")
-    for prefix, peak in peaks.items():
-        if kind.startswith(prefix):
-            return peak
-    return None
-
-
-def time_net(net, ds, *, is_graph, min_window_s=0.2, repeats=3, scan0=10):
-    import jax
-
-    net.fit_batch(ds)
-    float(net.score_value)
-
-    n = scan0
-    while True:
-        t0 = time.perf_counter()
-        net.fit_batch_repeated(ds, n)
-        float(net.score_value)
-        dt = time.perf_counter() - t0
-        if dt >= min_window_s:
-            break
-        # grow (first call at each n pays compile; re-time below)
-        n = max(n * 2, int(n * (min_window_s / max(dt, 1e-3)) * 1.3))
-        if n > 20000:
-            break
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        net.fit_batch_repeated(ds, n)
-        float(net.score_value)
-        times.append(time.perf_counter() - t0)
-    sec_per_step = min(times) / n
-    return sec_per_step, n
+from bench import _peak_flops, calibrated_step_time
 
 
 def main():
@@ -108,7 +73,7 @@ def main():
     ds = MultiDataSet([xd], [yd]) if is_graph else DataSet(xd, yd)
 
     t0 = time.perf_counter()
-    sec_per_step, n = time_net(net, ds, is_graph=is_graph)
+    sec_per_step, n = calibrated_step_time(net, ds, min_window_s=0.2, scan0=10)
     total = time.perf_counter() - t0
 
     out = {

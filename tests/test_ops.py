@@ -137,3 +137,52 @@ class TestSequenceOps:
         out = last_unmasked_step(x, mask)
         np.testing.assert_allclose(out[0], x[0, 2])
         np.testing.assert_allclose(out[1], x[1, 0])  # all-masked clamps to 0
+
+
+class TestBatchNormTrainOp:
+    """The hand-written BN training VJP (ops/normalization.py) must match
+    autodiff of the naive composed formulation — the
+    CudnnBatchNormalizationHelper equivalence analogue (CuDNNGradientChecks
+    pattern, SURVEY.md §4)."""
+
+    def _data(self):
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(1.0, 2.0, (6, 5, 4, 3)))
+        gamma = jnp.asarray(rng.normal(1.0, 0.3, (3,)))
+        beta = jnp.asarray(rng.normal(0.0, 0.5, (3,)))
+        return x, gamma, beta
+
+    @staticmethod
+    def _naive(x, gamma, beta, eps):
+        axes = tuple(range(x.ndim - 1))
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        return (x - m) / jnp.sqrt(v + eps) * gamma + beta
+
+    def test_forward_matches_naive(self):
+        from deeplearning4j_tpu.ops.normalization import batch_norm_train
+        x, gamma, beta, eps = *self._data(), 1e-5
+        y, mean, var = batch_norm_train(x, gamma, beta, eps)
+        np.testing.assert_allclose(y, self._naive(x, gamma, beta, eps),
+                                   rtol=1e-9, atol=1e-9)
+        axes = tuple(range(x.ndim - 1))
+        np.testing.assert_allclose(mean, jnp.mean(x, axis=axes), rtol=1e-9)
+        np.testing.assert_allclose(var, jnp.var(x, axis=axes), rtol=1e-9)
+
+    def test_vjp_matches_autodiff_of_naive(self):
+        # x64 (conftest): the hand-written dx/dgamma/dbeta must agree with
+        # jax.grad through the composed mean/var formulation to ~1e-9
+        from deeplearning4j_tpu.ops.normalization import batch_norm_train
+        x, gamma, beta, eps = *self._data(), 1e-5
+
+        def loss_naive(x, g, b):
+            return jnp.sum(jnp.sin(self._naive(x, g, b, eps)))
+
+        def loss_mine(x, g, b):
+            y, _, _ = batch_norm_train(x, g, b, eps)
+            return jnp.sum(jnp.sin(y))
+
+        ref = jax.grad(loss_naive, argnums=(0, 1, 2))(x, gamma, beta)
+        got = jax.grad(loss_mine, argnums=(0, 1, 2))(x, gamma, beta)
+        for r, g in zip(ref, got):
+            np.testing.assert_allclose(g, r, rtol=1e-7, atol=1e-9)
