@@ -30,55 +30,32 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.layers.base import Layer
-from deeplearning4j_tpu.ops import activations as act_mod
 from deeplearning4j_tpu.ops import initializers as init_mod
 from deeplearning4j_tpu.ops import losses as losses_mod
+from deeplearning4j_tpu.ops import lstm as _lstm  # registers lstm_sequence
+from deeplearning4j_tpu.ops import registry as ops
+
+del _lstm
 
 CARRY_KEYS = ("h", "c", "h_bwd", "c_bwd")
 
 
 def _lstm_scan(params, x, h0, c0, mask, gate_act, cell_act):
-    """Scan an LSTM over [b, t, f]; returns (y [b,t,n], hT, cT).
+    """Run an LSTM over [b, t, f]; returns (y [b,t,n], hT, cT).
 
     Runs entirely in x.dtype (the compute dtype — bf16 under the mixed
-    policy, so the recurrent matmul hits the MXU at full rate)."""
+    policy, so the recurrent matmul hits the MXU at full rate). The input
+    projection for the whole sequence is one MXU matmul; the time loop is
+    the ``lstm_sequence`` registry op (Pallas fused kernel on TPU, lax.scan
+    under autodiff elsewhere — the LSTMHelpers.java:57,271 seam)."""
     cd = x.dtype
     params = {k: v.astype(cd) for k, v in params.items()}
-    n = params["b"].shape[0] // 4
-    p_i = params["p"][0]
-    p_f = params["p"][1]
-    p_o = params["p"][2]
-
-    # project the whole sequence's input contribution in one MXU matmul
     xz = jnp.einsum("btf,fg->btg", x, params["Wx"]) + params["b"]
     xz_t = jnp.moveaxis(xz, 1, 0)  # [t, b, 4n]
     mask_t = None if mask is None else jnp.moveaxis(mask, 1, 0)  # [t, b]
-
-    def cell(carry, inp):
-        h_prev, c_prev = carry
-        if mask_t is None:
-            z = inp
-            m = None
-        else:
-            z, m = inp
-        z = z + h_prev @ params["Wh"]
-        zi, zf, zo, zg = (z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n],
-                          z[:, 3 * n:])
-        i = gate_act(zi + p_i * c_prev)
-        f = gate_act(zf + p_f * c_prev)
-        g = cell_act(zg)
-        c = f * c_prev + i * g
-        o = gate_act(zo + p_o * c)
-        h = o * cell_act(c)
-        if m is not None:
-            mcol = m[:, None]
-            h_keep = jnp.where(mcol > 0, h, h_prev)
-            c_keep = jnp.where(mcol > 0, c, c_prev)
-            return (h_keep, c_keep), h * mcol
-        return (h, c), h
-
-    xs = xz_t if mask_t is None else (xz_t, mask_t)
-    (hT, cT), ys = jax.lax.scan(cell, (h0, c0), xs)
+    ys, hT, cT = ops.get("lstm_sequence")(
+        xz_t, h0, c0, params["Wh"], params["p"], mask_t,
+        gate_act=gate_act, cell_act=cell_act)
     return jnp.moveaxis(ys, 0, 1), hT, cT
 
 
@@ -101,10 +78,6 @@ class GravesLSTMLayer(Layer):
     def init_params(self, key):
         return self._init_direction(key)
 
-    @property
-    def gate_fn(self):
-        return act_mod.get(self.conf.gate_activation)
-
     def _run(self, params, x, mask, carry, reverse=False):
         n = self.conf.n_out
         b = x.shape[0]
@@ -116,8 +89,9 @@ class GravesLSTMLayer(Layer):
         if reverse:
             x = jnp.flip(x, axis=1)
             mask = None if mask is None else jnp.flip(mask, axis=1)
-        y, hT, cT = _lstm_scan(params, x, h0, c0, mask, self.gate_fn,
-                               self.activation_fn)
+        y, hT, cT = _lstm_scan(params, x, h0, c0, mask,
+                               self.conf.gate_activation,
+                               self.resolve("activation", "tanh"))
         if reverse:
             y = jnp.flip(y, axis=1)
         return y, hT, cT

@@ -1,0 +1,355 @@
+"""Fused LSTM sequence op: XLA scan backend + Pallas TPU kernel backend.
+
+Parity: the reference's hand-fused LSTM
+(deeplearning4j-nn/.../recurrent/LSTMHelpers.java:57 activateHelper,
+:271 backpropGradientHelper) whose perf bar is the cuDNN fused LSTM. The
+registry seam (ops/registry.py) mirrors the reference's Helper loading
+(ConvolutionLayer.java:69-76): ``lstm_sequence`` has an ``xla`` backend
+(lax.scan of the cell — what autodiff differentiates) and a ``pallas``
+backend (this file's hand-written forward+backward kernels), equivalence
+-tested against each other in tests/test_backend_equivalence.py — the
+CuDNNGradientChecks.java analogue.
+
+Why a Pallas kernel: the scan path issues ~10 small XLA ops per timestep
+and re-reads the recurrent weight Wh from HBM every step (measured 88us
+per timestep on a v5e for batch 32, hidden 512 — 0.7% MFU). The Pallas
+kernel runs the WHOLE time loop in one kernel launch with Wh and the
+(h, c) carry resident in VMEM, streaming xz[t] in and (y[t], saves[t])
+out — the cuDNN-class schedule.
+
+Gate math (Graves formulation with peepholes, order i, f, o, g):
+    i = sigmoid(zi + p_i * c_prev)      f = sigmoid(zf + p_f * c_prev)
+    g = tanh(zg)                        c = f * c_prev + i * g
+    o = sigmoid(zo + p_o * c)           h = o * tanh(c)
+Masked steps carry (h, c) through unchanged and emit zero output.
+
+The op consumes the PRE-PROJECTED input xz[t] = x[t] @ Wx + b (one big
+MXU matmul outside the time loop); its backward emits dxz, from which
+dWx/db/dx are recovered by the caller with dense matmuls.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import activations as act_mod
+from deeplearning4j_tpu.ops import registry
+
+
+# ------------------------------------------------------------------ xla
+def _cell_step(Wh, p, gate_act, cell_act, carry, inp):
+    h_prev, c_prev = carry
+    z, m = inp
+    n = h_prev.shape[-1]
+    z = z + h_prev @ Wh
+    zi, zf, zo, zg = (z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n],
+                      z[:, 3 * n:])
+    i = gate_act(zi + p[0] * c_prev)
+    f = gate_act(zf + p[1] * c_prev)
+    g = cell_act(zg)
+    c = f * c_prev + i * g
+    o = gate_act(zo + p[2] * c)
+    h = o * cell_act(c)
+    if m is None:
+        return (h, c), h
+    mcol = m[:, None]
+    h_keep = jnp.where(mcol > 0, h, h_prev)
+    c_keep = jnp.where(mcol > 0, c, c_prev)
+    return (h_keep, c_keep), h * mcol
+
+
+@registry.register("lstm_sequence", backend="xla")
+def lstm_sequence_xla(xz_t, h0, c0, Wh, p, mask_t, *, gate_act="sigmoid",
+                      cell_act="tanh"):
+    """Time-major LSTM over pre-projected inputs.
+
+    xz_t: [t, b, 4n]; h0, c0: [b, n]; Wh: [n, 4n]; p: [3, n] peepholes;
+    mask_t: [t, b] or None. Returns (y_t [t, b, n], hT, cT)."""
+    ga = act_mod.get(gate_act) if isinstance(gate_act, str) else gate_act
+    ca = act_mod.get(cell_act) if isinstance(cell_act, str) else cell_act
+    step = partial(_cell_step, Wh, p, ga, ca)
+    if mask_t is None:
+        (hT, cT), ys = jax.lax.scan(
+            lambda carry, z: step(carry, (z, None)), (h0, c0), xz_t)
+    else:
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), (xz_t, mask_t))
+    return ys, hT, cT
+
+
+# --------------------------------------------------------------- pallas
+def _interpret():
+    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _pallas_supported(xz_t, h0, gate_act, cell_act):
+    if gate_act != "sigmoid" or cell_act != "tanh":
+        return False
+    if xz_t.dtype not in (jnp.bfloat16, jnp.float32):
+        return False
+    b, n = h0.shape[-2], h0.shape[-1]
+    sublane = 16 if xz_t.dtype == jnp.bfloat16 else 8
+    if n % 128 != 0 or b % sublane != 0:
+        return False
+    if not _interpret() and jax.default_backend() != "tpu":
+        return False
+    return True
+
+
+def _fwd_kernel(xz_ref, m_ref, h0_ref, c0_ref, Wh_ref, p_ref,
+                y_ref, hT_ref, cT_ref, G_ref, hprev_ref, cprev_ref,
+                h_scr, c_scr):
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h_prev = h_scr[:]
+    c_prev = c_scr[:]
+    cd = xz_ref.dtype
+    n = h_prev.shape[-1]
+
+    z = xz_ref[0].astype(jnp.float32) + jnp.dot(
+        h_prev.astype(cd), Wh_ref[:], preferred_element_type=jnp.float32)
+    pvec = p_ref[:].astype(jnp.float32)
+    i = jax.nn.sigmoid(z[:, :n] + pvec[0:1, :] * c_prev)
+    f = jax.nn.sigmoid(z[:, n:2 * n] + pvec[1:2, :] * c_prev)
+    g = jnp.tanh(z[:, 3 * n:])
+    c = f * c_prev + i * g
+    o = jax.nn.sigmoid(z[:, 2 * n:3 * n] + pvec[2:3, :] * c)
+    h = o * jnp.tanh(c)
+
+    m = m_ref[0].astype(jnp.float32)
+    h_keep = jnp.where(m > 0, h, h_prev)
+    c_keep = jnp.where(m > 0, c, c_prev)
+
+    y_ref[0] = (h * m).astype(cd)
+    G_ref[0] = jnp.concatenate([i, f, o, g], axis=-1).astype(cd)
+    hprev_ref[0] = h_prev.astype(cd)
+    cprev_ref[0] = c_prev.astype(cd)
+    h_scr[:] = h_keep
+    c_scr[:] = c_keep
+
+    @pl.when(t == T - 1)
+    def _():
+        hT_ref[:] = h_keep.astype(cd)
+        cT_ref[:] = c_keep.astype(cd)
+
+
+def _bwd_kernel(G_ref, hprev_ref, cprev_ref, m_ref, Wh_ref, p_ref,
+                dy_ref, dhT_ref, dcT_ref,
+                dxz_ref, dh0_ref, dc0_ref, dWh_ref, dp_ref,
+                dh_scr, dc_scr, dWh_scr, dp_scr):
+    import jax.experimental.pallas as pl
+
+    pid = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(pid == 0)
+    def _():
+        dh_scr[:] = dhT_ref[:].astype(jnp.float32)
+        dc_scr[:] = dcT_ref[:].astype(jnp.float32)
+        dWh_scr[:] = jnp.zeros_like(dWh_scr)
+        dp_scr[:] = jnp.zeros_like(dp_scr)
+
+    cd = G_ref.dtype
+    n = hprev_ref.shape[-1]
+    G = G_ref[0].astype(jnp.float32)
+    i, f, o, g = (G[:, :n], G[:, n:2 * n], G[:, 2 * n:3 * n], G[:, 3 * n:])
+    h_prev = hprev_ref[0].astype(jnp.float32)
+    c_prev = cprev_ref[0].astype(jnp.float32)
+    pvec = p_ref[:].astype(jnp.float32)
+    m = m_ref[0].astype(jnp.float32)
+
+    c = f * c_prev + i * g
+    tc = jnp.tanh(c)
+
+    dh_next = dh_scr[:]
+    dc_next = dc_scr[:]
+
+    dh = m * (dh_next + dy_ref[0].astype(jnp.float32))
+    do = dh * tc
+    dzo = do * o * (1.0 - o)
+    dc_in = m * dc_next + dh * o * (1.0 - tc * tc) + dzo * pvec[2:3, :]
+    di = dc_in * g
+    df = dc_in * c_prev
+    dg = dc_in * i
+    dzi = di * i * (1.0 - i)
+    dzf = df * f * (1.0 - f)
+    dzg = dg * (1.0 - g * g)
+
+    dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)
+    dz_cd = dz.astype(cd)
+
+    # dh_prev = dz @ Wh^T  (contract the 4n dim)
+    dh_prev = jax.lax.dot_general(
+        dz_cd, Wh_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dh_prev = dh_prev + (1.0 - m) * dh_next
+    dc_prev = dc_in * f + dzi * pvec[0:1, :] + dzf * pvec[1:2, :] \
+        + (1.0 - m) * dc_next
+
+    # dWh += h_prev^T @ dz  (contract the batch dim)
+    dWh_scr[:] += jax.lax.dot_general(
+        hprev_ref[0], dz_cd, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp_scr[0:1, :] += jnp.sum(dzi * c_prev, axis=0, keepdims=True)
+    dp_scr[1:2, :] += jnp.sum(dzf * c_prev, axis=0, keepdims=True)
+    dp_scr[2:3, :] += jnp.sum(dzo * c, axis=0, keepdims=True)
+
+    dxz_ref[0] = dz_cd
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(pid == T - 1)
+    def _():
+        dh0_ref[:] = dh_prev.astype(cd)
+        dc0_ref[:] = dc_prev.astype(cd)
+        dWh_ref[:] = dWh_scr[:].astype(cd)
+        dp_ref[:] = dp_scr[:].astype(cd)
+
+
+def _fwd_call(xz_t, h0, c0, Wh, p, mask_t):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, b, n4 = xz_t.shape
+    n = n4 // 4
+    cd = xz_t.dtype
+    sds = jax.ShapeDtypeStruct
+    out_shapes = (
+        sds((T, b, n), cd),    # y
+        sds((b, n), cd),       # hT
+        sds((b, n), cd),       # cT
+        sds((T, b, n4), cd),   # G (gates i,f,o,g)
+        sds((T, b, n), cd),    # h_prev per step
+        sds((T, b, n), cd),    # c_prev per step
+    )
+    t_block = lambda width: pl.BlockSpec(
+        (1, b, width), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+    full = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    fixed2 = lambda r, cdim: pl.BlockSpec(
+        (r, cdim), lambda t: (0, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            t_block(n4),                                     # xz
+            pl.BlockSpec((1, b, 1), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),           # mask [t,b,1]
+            fixed2(b, n), fixed2(b, n),                      # h0, c0
+            fixed2(n, n4),                                   # Wh
+            fixed2(3, n),                                    # p
+        ],
+        out_specs=(
+            t_block(n),                                      # y
+            fixed2(b, n), fixed2(b, n),                      # hT, cT
+            t_block(n4),                                     # G
+            t_block(n), t_block(n),                          # h_prev, c_prev
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((b, n), jnp.float32),
+            pltpu.VMEM((b, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xz_t, mask_t[:, :, None], h0, c0, Wh, p)
+
+
+def _bwd_call(res, cts):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    G, hprev, cprev, mask_t, Wh, p = res
+    dy, dhT, dcT = cts
+    T, b, n = hprev.shape
+    n4 = 4 * n
+    cd = G.dtype
+    dy = dy.astype(cd)
+    dhT = dhT.astype(cd)
+    dcT = dcT.astype(cd)
+    sds = jax.ShapeDtypeStruct
+    out_shapes = (
+        sds((T, b, n4), cd),   # dxz
+        sds((b, n), cd),       # dh0
+        sds((b, n), cd),       # dc0
+        sds((n, n4), cd),      # dWh
+        sds((3, n), cd),       # dp
+    )
+    rev = lambda width: pl.BlockSpec(
+        (1, b, width), lambda i: (T - 1 - i, 0, 0), memory_space=pltpu.VMEM)
+    fixed2 = lambda r, cdim: pl.BlockSpec(
+        (r, cdim), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            rev(n4),                                         # G
+            rev(n), rev(n),                                  # h_prev, c_prev
+            pl.BlockSpec((1, b, 1), lambda i: (T - 1 - i, 0, 0),
+                         memory_space=pltpu.VMEM),           # mask [t,b,1]
+            fixed2(n, n4),                                   # Wh
+            fixed2(3, n),                                    # p
+            rev(n),                                          # dy
+            fixed2(b, n), fixed2(b, n),                      # dhT, dcT
+        ],
+        out_specs=(
+            rev(n4),                                         # dxz
+            fixed2(b, n), fixed2(b, n),                      # dh0, dc0
+            fixed2(n, n4),                                   # dWh
+            fixed2(3, n),                                    # dp
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((b, n), jnp.float32),
+            pltpu.VMEM((b, n), jnp.float32),
+            pltpu.VMEM((n, n4), jnp.float32),
+            pltpu.VMEM((3, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(G, hprev, cprev, mask_t[:, :, None], Wh, p, dy, dhT, dcT)
+
+
+@jax.custom_vjp
+def _lstm_seq_pallas(xz_t, h0, c0, Wh, p, mask_t):
+    y, hT, cT, _, _, _ = _fwd_call(xz_t, h0, c0, Wh, p, mask_t)
+    return y, hT, cT
+
+
+def _lstm_seq_fwd(xz_t, h0, c0, Wh, p, mask_t):
+    y, hT, cT, G, hprev, cprev = _fwd_call(xz_t, h0, c0, Wh, p, mask_t)
+    return (y, hT, cT), (G, hprev, cprev, mask_t, Wh, p)
+
+
+def _lstm_seq_bwd(res, cts):
+    dxz, dh0, dc0, dWh, dp = _bwd_call(res, cts)
+    return dxz, dh0, dc0, dWh, dp, None
+
+
+_lstm_seq_pallas.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)
+
+
+@registry.register("lstm_sequence", backend="pallas")
+def lstm_sequence_pallas(xz_t, h0, c0, Wh, p, mask_t, *, gate_act="sigmoid",
+                         cell_act="tanh"):
+    """Pallas-fused LSTM sequence; silently delegates to the xla backend
+    for configurations the kernel does not cover (non-sigmoid/tanh
+    activations, unaligned shapes, non-TPU platforms) — the same graceful
+    fallback the reference's helper loading performs when cuDNN is absent
+    (ConvolutionLayer.java:69-76)."""
+    if not _pallas_supported(xz_t, h0, gate_act, cell_act):
+        return lstm_sequence_xla(xz_t, h0, c0, Wh, p, mask_t,
+                                 gate_act=gate_act, cell_act=cell_act)
+    if mask_t is None:
+        mask_t = jnp.ones(xz_t.shape[:2], xz_t.dtype)
+    else:
+        mask_t = mask_t.astype(xz_t.dtype)
+    return _lstm_seq_pallas(xz_t, h0, c0, Wh, p, mask_t)
