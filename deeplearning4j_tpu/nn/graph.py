@@ -40,10 +40,12 @@ class ComputationGraph:
         self.listeners: list = []
         self.score_value = None
         self._train_step = None
+        self._tbptt_step = None
         self._multi_steps = {}
         self._apply_fns = {}
         self._mesh = None
         self._rng_key = None
+        self._rnn_state = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
@@ -116,8 +118,10 @@ class ComputationGraph:
             self.params, self.state, self.opt_state = init_trees(self._rng_key)
         self.iteration = 0
         self._train_step = None
+        self._tbptt_step = None
         self._multi_steps = {}
         self._apply_fns = {}
+        self._rnn_state = None
         return self
 
     def materialize_state(self):
@@ -145,8 +149,10 @@ class ComputationGraph:
         from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
         self._mesh = (mesh, data_axis)
         self._train_step = None
+        self._tbptt_step = None
         self._multi_steps = {}
         self._apply_fns = {}
+        self._rnn_state = None
         apply_mesh(self, mesh, data_axis)
         return self
 
@@ -289,7 +295,8 @@ class ComputationGraph:
         mds = self._coerce(mds)
         if self._mesh is not None or self.conf.backprop_type == "tbptt":
             # meshed execution needs shard_step_multi's batch handling;
-            # tbptt keeps fit_batch's (currently unsupported) semantics
+            # tbptt needs chunked backprop — both route through fit_batch
+            # (n dispatches) to keep semantics identical
             for _ in range(n_steps):
                 score = self.fit_batch(mds)
             return score
@@ -318,16 +325,140 @@ class ComputationGraph:
             return MultiDataSet.from_dataset(data)
         raise TypeError(f"Expected DataSet or MultiDataSet, got {type(data)}")
 
+    # ------------------------------------------------ recurrent state helpers
+    def _set_streaming(self, flag: bool):
+        from deeplearning4j_tpu.nn.layers.recurrent import set_streaming
+        set_streaming(self.layers, flag)
+
+    def _strip_carries(self, state):
+        from deeplearning4j_tpu.nn.layers.recurrent import strip_carries
+        return strip_carries(state)
+
+    def rnn_clear_previous_state(self):
+        """Reset streaming decode state (rnnClearPreviousState parity)."""
+        self._rnn_state = None
+
+    def rnn_time_step(self, *features, masks=None):
+        """Stateful streaming inference (ComputationGraph.rnnTimeStep
+        parity): feed one step [b, f] or a chunk [b, t, f] per network
+        input; recurrent layer vertices carry (h, c) across calls."""
+        self._require_init()
+        feats = [jnp.asarray(f) for f in features]
+        # single-step mode: no input carries a time axis. Recurrent-typed
+        # inputs are expanded to [b, 1, f]; static 2d inputs (e.g. the
+        # non-sequence side of DuplicateToTimeSeries) are left alone.
+        single = all(f.ndim == 2 for f in feats)
+        if single:
+            its = self.conf.input_types or [None] * len(feats)
+            feats = [f[:, None, :]
+                     if (it is not None and it.kind == "recurrent")
+                     else f
+                     for f, it in zip(feats, its)]
+        self._set_streaming(True)
+        try:
+            key = "stream"
+            if key not in self._apply_fns:
+                def fn(params, state, inputs, fmasks):
+                    acts, _, _, new_state = self._walk(
+                        params, state, inputs, train=False, rng=None,
+                        fmasks=fmasks)
+                    return (tuple(acts[o]
+                                  for o in self.conf.network_outputs),
+                            new_state)
+                self._apply_fns[key] = jax.jit(fn)
+            inputs, fmasks = self._prepare_inputs(feats, masks)
+            state_in = getattr(self, "_rnn_state", None)
+            if state_in is None:
+                state_in = self.state
+            outs, new_state = self._apply_fns[key](self.params, state_in,
+                                                   inputs, fmasks)
+            self._rnn_state = new_state
+        finally:
+            self._set_streaming(False)
+        if single:
+            outs = tuple(o[:, 0, :] if o.ndim == 3 else o for o in outs)
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------- training
+    def _fit_tbptt(self, mds):
+        """Truncated BPTT on the DAG (ComputationGraphConfiguration tBPTT /
+        ComputationGraph.doTruncatedBPTT parity): split the time axis of
+        every time-series input/label into tbptt_fwd_length chunks;
+        recurrent vertices carry (h, c) across chunks via the state pytree,
+        reset per batch. Static (2d) inputs are fed whole to every chunk."""
+        L = self.conf.tbptt_fwd_length
+        feats = [jnp.asarray(f) for f in mds.features]
+        labels = [jnp.asarray(l) for l in mds.labels]
+        if any(l.ndim == 2 for l in labels):
+            raise ValueError(
+                "tBPTT requires per-timestep labels [batch, time, out]; got "
+                "a 2d (sequence-classification) label — use "
+                "backprop_type='standard' for sequence classification")
+        t_lens = {f.shape[1] for f in feats if f.ndim == 3}
+        t_lens |= {l.shape[1] for l in labels if l.ndim == 3}
+        if len(t_lens) != 1:
+            raise ValueError(
+                "tBPTT requires all time-series inputs AND per-timestep "
+                "labels to share one time length; got time lengths "
+                f"{sorted(t_lens)} (sequence-classification labels need "
+                "backprop_type='standard')")
+        t_total = t_lens.pop()
+        fmasks = [None if m is None else jnp.asarray(m)
+                  for m in mds.features_masks]
+        lmasks = [None if m is None else jnp.asarray(m)
+                  for m in mds.labels_masks]
+
+        def chunk(a, sl, time_like):
+            if a is None:
+                return None
+            return a[:, sl] if time_like(a) else a
+
+        self._set_streaming(True)
+        try:
+            if getattr(self, "_tbptt_step", None) is None:
+                self._tbptt_step = self._build_train_step()
+            score_sum, weight = 0.0, 0
+            for start in range(0, t_total, L):
+                sl = slice(start, min(start + L, t_total))
+                inputs = {n: chunk(f, sl, lambda a: a.ndim == 3)
+                          for n, f in zip(self.conf.network_inputs, feats)}
+                lab = [chunk(l, sl, lambda a: a.ndim == 3) for l in labels]
+                fm = {n: chunk(m, sl, lambda a: a.ndim == 2)
+                      for n, m in zip(self.conf.network_inputs, fmasks)
+                      if m is not None}
+                lm = [chunk(m, sl, lambda a: a.ndim == 2) for m in lmasks]
+                if all(m is None for m in lm):
+                    lm = None
+                self._rng_key, rng = jax.random.split(self._rng_key)
+                it = jnp.asarray(self.iteration, jnp.int32)
+                (self.params, self.state, self.opt_state,
+                 chunk_score) = self._tbptt_step(
+                    self.params, self.state, self.opt_state, it, inputs,
+                    lab, fm, lm, rng)
+                w = sl.stop - sl.start
+                score_sum = score_sum + float(chunk_score) * w
+                weight += w
+            self.state = self._strip_carries(self.state)
+            score = score_sum / max(weight, 1)
+        finally:
+            self._set_streaming(False)
+        self.iteration += 1
+        self.score_value = score
+        self.last_batch_examples = mds.num_examples
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+        return score
+
     def fit_batch(self, mds):
         """One optimization step on one (Multi)DataSet minibatch
         (ComputationGraph.fit parity)."""
         self._require_init()
-        if self.conf.backprop_type == "tbptt":
-            raise NotImplementedError(
-                "Truncated BPTT is not yet implemented for ComputationGraph "
-                "(supported on MultiLayerNetwork); use backprop_type="
-                "'standard' or a sequential net")
         mds = self._coerce(mds)
+        if self.conf.backprop_type == "tbptt":
+            t_dims = {f.shape[1] for f in mds.features
+                      if getattr(f, "ndim", 0) == 3}
+            if t_dims and max(t_dims) > self.conf.tbptt_fwd_length:
+                return self._fit_tbptt(mds)
         if self._train_step is None:
             self._train_step = self._build_train_step()
         self._rng_key, rng = jax.random.split(self._rng_key)
@@ -348,8 +479,11 @@ class ComputationGraph:
             l.iteration_done(self, self.iteration, self.epoch)
         return score
 
-    def fit(self, data, *, epochs: int = 1):
-        """Train on an iterator of DataSet/MultiDataSet, or a single one."""
+    def fit(self, data, *, epochs: int = 1, async_prefetch: bool = True):
+        """Train on an iterator of DataSet/MultiDataSet, or a single one.
+        Iterators are wrapped in a background prefetch thread
+        (AsyncDataSetIterator auto-wrap parity, MultiLayerNetwork.java:951 /
+        ComputationGraph.java:701)."""
         if isinstance(data, (DataSet, MultiDataSet)):
             items = [data]
             for _ in range(epochs):
@@ -357,14 +491,83 @@ class ComputationGraph:
                     self.fit_batch(d)
                 self.epoch += 1
             return self
+        from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator
         for _ in range(epochs):
-            for d in data:
+            source = data
+            if async_prefetch and hasattr(data, "reset"):
+                source = AsyncDataSetIterator(data)
+            for d in source:
                 self.fit_batch(d)
             if hasattr(data, "reset"):
                 data.reset()
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
+        return self
+
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, data, *, epochs: int = 1):
+        """Layer-wise unsupervised pretraining over the DAG
+        (ComputationGraph.pretrain parity): each pretrainable layer vertex
+        (VAE/AutoEncoder/RBM) trains on the activations its input vertices
+        produce under the current parameters, in topological order."""
+        self._require_init()
+        for name in self.topo:
+            layer = self._layer_by_name.get(name) if self.vertex_kind[
+                name] == "layer" else None
+            if layer is not None and getattr(layer, "is_pretrainable", False):
+                self.pretrain_layer(name, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, name: str, data, *, epochs: int = 1):
+        """Pretrain one layer vertex on its featurized input (the
+        pretrainLayer(String, DataSetIterator) overload)."""
+        self._require_init()
+        layer = self._layer_by_name.get(name)
+        if layer is None or not getattr(layer, "is_pretrainable", False):
+            raise ValueError(f"Vertex '{name}' is not a pretrainable layer")
+        gc = self.conf.global_conf
+
+        def step(params, opt_state, itc, x, rng):
+            def loss_fn(p):
+                return layer.pretrain_loss(p[name], x, rng)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = apply_layer_updates(
+                [layer], gc, params, grads, opt_state, itc)
+            return new_params, new_opt, loss
+
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+
+        def featurize(params, state, inputs, fmasks):
+            _, saved, _, _ = self._walk(params, state, inputs, train=False,
+                                        rng=None, fmasks=fmasks,
+                                        need_inputs_of=(name,))
+            return saved[name][0][0]
+
+        feat_fn = jax.jit(featurize)
+        params_sub = {name: self.params[name]}
+        opt_sub = {name: self.opt_state[name]}
+        last = None
+        iteration = self.iteration
+        items = ([data] if isinstance(data, (DataSet, MultiDataSet))
+                 else data)
+        for _ in range(epochs):
+            for d in items:
+                mds = self._coerce(d)
+                inputs, fmasks = self._prepare_inputs(mds.features,
+                                                      mds.features_masks)
+                x = feat_fn(self.params, self.state, inputs, fmasks)
+                self._rng_key, rng = jax.random.split(self._rng_key)
+                itc = jnp.asarray(iteration, jnp.int32)
+                params_sub, opt_sub, last = jitted(params_sub, opt_sub, itc,
+                                                   x, rng)
+                iteration += 1
+            if hasattr(items, "reset"):
+                items.reset()
+        self.iteration = iteration
+        self.params = {**self.params, name: params_sub[name]}
+        self.opt_state = {**self.opt_state, name: opt_sub[name]}
+        self.score_value = last
         return self
 
     # ------------------------------------------------------------ inference
@@ -407,12 +610,10 @@ class ComputationGraph:
                              lmasks, rng=None, train=train)
         return float(loss)
 
-    def evaluate(self, iterator):
-        """Classification eval for single-output graphs (evaluate parity)."""
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
+    def _evaluate_with(self, ev, iterator, what: str):
+        """Shared single-output eval loop for evaluate/evaluate_regression."""
         if len(self.conf.network_outputs) != 1:
-            raise ValueError("evaluate() requires a single-output graph")
-        ev = Evaluation()
+            raise ValueError(f"{what}() requires a single-output graph")
         if isinstance(iterator, (DataSet, MultiDataSet)):
             iterator = [iterator]
         for d in iterator:
@@ -422,6 +623,18 @@ class ComputationGraph:
                 if any(m is not None for m in mds.features_masks) else None))
             ev.eval(mds.labels[0], np.asarray(out), mask=mds.labels_masks[0])
         return ev
+
+    def evaluate(self, iterator):
+        """Classification eval for single-output graphs (evaluate parity)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        return self._evaluate_with(Evaluation(), iterator, "evaluate")
+
+    def evaluate_regression(self, iterator):
+        """Regression eval for single-output graphs (evaluateRegression
+        parity)."""
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        return self._evaluate_with(RegressionEvaluation(), iterator,
+                                   "evaluate_regression")
 
     # ---------------------------------------------------------------- misc
     def num_params(self) -> int:

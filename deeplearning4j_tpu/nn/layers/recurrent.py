@@ -183,3 +183,22 @@ class LastTimeStepLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         from deeplearning4j_tpu.ops.sequence import last_unmasked_step
         return last_unmasked_step(x, mask), state
+
+
+def set_streaming(layers, flag: bool):
+    """Toggle stateful (h, c) carry on every recurrent layer — shared by
+    MultiLayerNetwork and ComputationGraph streaming/tBPTT paths."""
+    for layer in layers:
+        if getattr(layer, "is_recurrent_stateful", False):
+            layer.streaming = flag
+
+
+def strip_carries(state):
+    """Drop recurrent (h, c) carries from a state pytree (batch-boundary
+    reset after tBPTT / streaming)."""
+    out = {}
+    for name, sub in state.items():
+        kept = {k: v for k, v in sub.items() if k not in CARRY_KEYS}
+        if kept:
+            out[name] = kept
+    return out

@@ -308,18 +308,12 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------ recurrent state helpers
     def _set_streaming(self, flag: bool):
-        for layer in self.layers:
-            if getattr(layer, "is_recurrent_stateful", False):
-                layer.streaming = flag
+        from deeplearning4j_tpu.nn.layers.recurrent import set_streaming
+        set_streaming(self.layers, flag)
 
     def _strip_carries(self, state):
-        from deeplearning4j_tpu.nn.layers.recurrent import CARRY_KEYS
-        out = {}
-        for name, sub in state.items():
-            kept = {k: v for k, v in sub.items() if k not in CARRY_KEYS}
-            if kept:
-                out[name] = kept
-        return out
+        from deeplearning4j_tpu.nn.layers.recurrent import strip_carries
+        return strip_carries(state)
 
     def rnn_clear_previous_state(self):
         """Reset streaming decode state (rnnClearPreviousState parity)."""

@@ -3,6 +3,8 @@ TrainedModels.java / ModelGuesser.java model-zoo hooks, and the configs
 BASELINE.md measures — LeNet-MNIST, ResNet-50, GravesLSTM char-RNN)."""
 
 from deeplearning4j_tpu.zoo.models import (
+    BF16,
+    F32,
     char_rnn,
     lenet,
     mnist_mlp,
@@ -10,4 +12,5 @@ from deeplearning4j_tpu.zoo.models import (
     resnet50,
 )
 
-__all__ = ["char_rnn", "lenet", "mnist_mlp", "resnet18", "resnet50"]
+__all__ = ["BF16", "F32", "char_rnn", "lenet", "mnist_mlp", "resnet18",
+           "resnet50"]

@@ -325,3 +325,143 @@ def test_graph_mesh_training():
     for _ in range(30):
         net.fit_batch(ds)
     assert net.score(ds) < s0
+
+
+# ---------------------------------------------------------------- CG parity
+def _rnn_graph(tbptt=None, f=4, classes=2, hidden=8, seed=42):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(5e-3))
+         .dtype(F64).graph_builder().add_inputs("seq"))
+    if tbptt:
+        b = b.backprop_type("tbptt", tbptt, tbptt)
+    conf = (b.add_layer("lstm", GravesLSTM(n_out=hidden, activation="tanh"),
+                        "seq")
+            .add_layer("out", RnnOutput(n_out=classes, activation="softmax",
+                                        loss="mcxent"), "lstm")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(f))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def test_graph_tbptt_training_runs_and_learns():
+    """CG tBPTT chunks the time axis and carries LSTM state across chunks
+    (ComputationGraphConfiguration tBPTT parity — round-2 gap at
+    graph.py:341)."""
+    rng = np.random.default_rng(0)
+    n, t, f, classes = 32, 12, 4, 2
+    # the label depends on the FIRST chunk: state must carry across chunks
+    x = rng.normal(size=(n, t, f))
+    y_idx = (x[:, :4, :].mean(axis=(1, 2)) > 0).astype(int)
+    y = np.eye(classes)[np.repeat(y_idx[:, None], t, axis=1)]
+    net = _rnn_graph(tbptt=4, f=f, classes=classes)
+    ds = MultiDataSet([x], [y])
+    for _ in range(60):
+        net.fit_batch(ds)
+    for sub in net.state.values():
+        assert "h" not in sub and "c" not in sub
+    assert float(net.score(ds)) < 0.55
+
+
+def test_graph_tbptt_matches_standard_when_single_chunk():
+    """With t <= tbptt_fwd_length the chunked path must be identical to a
+    standard full-sequence step (same params after one batch)."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 4, 4))
+    y = np.eye(2)[rng.integers(0, 2, (8, 4))]
+    ds = MultiDataSet([x], [y])
+    a = _rnn_graph(tbptt=8, seed=9)
+    b = _rnn_graph(tbptt=None, seed=9)
+    a.fit_batch(ds)
+    b.fit_batch(ds)
+    for name in a.params:
+        for k in a.params[name]:
+            np.testing.assert_allclose(a.params[name][k], b.params[name][k],
+                                       rtol=1e-12, atol=1e-12)
+
+
+def test_graph_rnn_time_step_streaming_matches_full():
+    """CG streaming decode: chunked rnn_time_step == full-sequence output
+    (the ComputationGraph.rnnTimeStep parity gap from round 2)."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(3, 6, 4))
+    net = _rnn_graph()
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    a = np.asarray(net.rnn_time_step(x[:, :2, :]))
+    b = np.asarray(net.rnn_time_step(x[:, 2:, :]))
+    np.testing.assert_allclose(full, np.concatenate([a, b], axis=1),
+                               rtol=1e-8, atol=1e-10)
+    # single-step [b, f] form returns [b, out]
+    net.rnn_clear_previous_state()
+    s = np.asarray(net.rnn_time_step(x[:, 0, :]))
+    np.testing.assert_allclose(s, full[:, 0, :], rtol=1e-8, atol=1e-10)
+
+
+def test_graph_pretrain_autoencoder_vertex():
+    """CG layer-wise pretraining (pretrainLayer(String, iter) parity):
+    the AE vertex trains on its featurized input and reconstruction
+    improves."""
+    from deeplearning4j_tpu.nn.conf.layers_pretrain import AutoEncoder
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(64, 6)).astype(np.float64)
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-2))
+            .dtype(F64).graph_builder().add_inputs("in")
+            .add_layer("ae", AutoEncoder(n_out=4, activation="tanh"), "in")
+            .add_layer("out", Output(n_out=2, activation="softmax",
+                                     loss="mcxent"), "ae")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(6))
+            .build())
+    net = ComputationGraph(conf).init()
+    y = np.eye(2)[rng.integers(0, 2, 64)]
+    mds = MultiDataSet([x], [y])
+    net.pretrain(mds, epochs=1)
+    first = float(net.score_value)
+    net.pretrain(mds, epochs=30)
+    assert float(net.score_value) < first
+
+
+def test_graph_evaluate_regression():
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(40, 3))
+    W = rng.normal(size=(3, 2))
+    y = x @ W + 0.01 * rng.normal(size=(40, 2))
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(5e-2))
+            .dtype(F64).graph_builder().add_inputs("in")
+            .add_layer("out", Output(n_out=2, activation="identity",
+                                     loss="mse"), "in")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    net = ComputationGraph(conf).init()
+    mds = MultiDataSet([x], [y])
+    for _ in range(200):
+        net.fit_batch(mds)
+    ev = net.evaluate_regression(mds)
+    assert ev.average_mean_squared_error() < 0.01
+
+
+def test_graph_rnn_time_step_multi_input_static_plus_sequence():
+    """Single-step streaming with a STATIC 2d first input (review finding:
+    single-step mode must be decided per input, not from features[0])."""
+    rng = np.random.default_rng(2)
+    conf = (NeuralNetConfiguration.builder().seed(6).updater(Adam(1e-2))
+            .dtype(F64).graph_builder().add_inputs("static", "seq")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(seq_input="seq"),
+                        "static")
+            .add_layer("lstm", GravesLSTM(n_out=5, activation="tanh"), "seq")
+            .add_vertex("cat", MergeVertex(), "lstm", "dup")
+            .add_layer("out", RnnOutput(n_out=2, activation="softmax",
+                                        loss="mcxent"), "cat")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3), InputType.recurrent(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    static = rng.normal(size=(2, 3))
+    seq = rng.normal(size=(2, 6, 4))
+    full = np.asarray(net.output(static, seq))
+    net.rnn_clear_previous_state()
+    steps = [np.asarray(net.rnn_time_step(static, seq[:, i, :]))
+             for i in range(6)]
+    np.testing.assert_allclose(full, np.stack(steps, axis=1),
+                               rtol=1e-8, atol=1e-10)
