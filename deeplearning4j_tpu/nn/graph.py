@@ -349,9 +349,13 @@ class ComputationGraph:
         # non-sequence side of DuplicateToTimeSeries) are left alone.
         single = all(f.ndim == 2 for f in feats)
         if single:
+            # untyped inputs default to time-series (matching the
+            # MultiLayerNetwork behavior); only inputs explicitly typed
+            # non-recurrent (e.g. the static side of
+            # DuplicateToTimeSeries) stay 2d
             its = self.conf.input_types or [None] * len(feats)
             feats = [f[:, None, :]
-                     if (it is not None and it.kind == "recurrent")
+                     if (it is None or it.kind == "recurrent")
                      else f
                      for f, it in zip(feats, its)]
         self._set_streaming(True)
@@ -545,8 +549,13 @@ class ComputationGraph:
             return saved[name][0][0]
 
         feat_fn = jax.jit(featurize)
-        params_sub = {name: self.params[name]}
-        opt_sub = {name: self.opt_state[name]}
+        # material copies: the jitted step donates these buffers, and the
+        # net's own trees must never alias donated (deleted) arrays — an
+        # exception mid-loop would otherwise corrupt the whole net
+        params_sub = {name: jax.tree_util.tree_map(jnp.copy,
+                                                   self.params[name])}
+        opt_sub = {name: jax.tree_util.tree_map(jnp.copy,
+                                                self.opt_state[name])}
         last = None
         iteration = self.iteration
         items = ([data] if isinstance(data, (DataSet, MultiDataSet))

@@ -476,8 +476,13 @@ class MultiLayerNetwork:
             return new_params, new_opt, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1))
-        params_sub = {name: self.params[name]}
-        opt_sub = {name: self.opt_state[name]}
+        # material copies: the jitted step donates these buffers, and the
+        # net's own trees must never alias donated (deleted) arrays — an
+        # exception mid-loop would otherwise corrupt the whole net
+        params_sub = {name: jax.tree_util.tree_map(jnp.copy,
+                                                   self.params[name])}
+        opt_sub = {name: jax.tree_util.tree_map(jnp.copy,
+                                                self.opt_state[name])}
         last = None
         iteration = self.iteration
         for _ in range(epochs):

@@ -22,12 +22,25 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def replicate(mesh: Mesh, x):
+    """Replicate a host value across the (possibly multi-process) mesh.
+    In a multi-process runtime plain device_put cannot address remote
+    devices; every process holds the identical full value, so the
+    process-local-data assembly path produces the replicated global
+    Array."""
+    repl = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(repl, np.asarray(x))
+    return jax.device_put(x, repl)
+
+
 def apply_mesh(net, mesh: Mesh, data_axis: str = "data"):
     """Replicate the net's params/state/opt state across the mesh. Batches
     get sharded in fit_batch; computation follows sharding, so the jitted
-    step becomes data-parallel with an ICI all-reduce on gradients."""
-    repl = NamedSharding(mesh, P())
-    put = lambda tree: jax.device_put(tree, repl)
+    step becomes data-parallel with an ICI (and, across hosts, DCN)
+    all-reduce on gradients."""
+    put = lambda tree: jax.tree_util.tree_map(
+        lambda leaf: replicate(mesh, leaf), tree)
     if net.params is not None:
         net.params = put(net.params)
     if net.state:
@@ -38,11 +51,17 @@ def apply_mesh(net, mesh: Mesh, data_axis: str = "data"):
 
 
 def shard_batch(mesh: Mesh, data_axis: str, x):
-    """Place a host batch sharded over the data axis (leading dim)."""
+    """Place a host batch sharded over the data axis (leading dim). In a
+    multi-process runtime each process passes its LOCAL slice of the
+    global batch (the Spark-partition analogue — SURVEY.md §3.4); the
+    slices are assembled into one global sharded Array."""
     if x is None:
         return None
     spec = P(data_axis) if np.ndim(x) >= 1 else P()
-    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sh, np.asarray(x))
+    return jax.device_put(jnp.asarray(x), sh)
 
 
 def _pad_batch(x, labels, fmask, lmask, multiple: int):
@@ -72,18 +91,20 @@ def shard_step(net, step_fn, mesh: Mesh, data_axis: str = "data"):
     """Jit the train step for mesh execution. Params arrive replicated and
     batches sharded (set by apply_mesh/shard_batch); partial batches are
     zero-padded + mask-excluded so any batch size divides the mesh."""
-    repl = NamedSharding(mesh, P())
     n_shards = mesh.shape[data_axis]
+    # each process pads its LOCAL slice to its local share of the data axis
+    pad_multiple = max(n_shards // jax.process_count(), 1)
 
     jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     def wrapped(params, state, opt_state, it, x, labels, fmask, lmask, rng):
-        x, labels, fmask, lmask = _pad_batch(x, labels, fmask, lmask, n_shards)
+        x, labels, fmask, lmask = _pad_batch(x, labels, fmask, lmask,
+                                             pad_multiple)
         x = shard_batch(mesh, data_axis, x)
         labels = shard_batch(mesh, data_axis, labels)
         fmask = shard_batch(mesh, data_axis, fmask)
         lmask = shard_batch(mesh, data_axis, lmask)
-        rng = jax.device_put(rng, repl)
+        rng = replicate(mesh, rng)
         return jitted(params, state, opt_state, it, x, labels, fmask, lmask, rng)
 
     return wrapped
@@ -100,15 +121,16 @@ def shard_step_multi(net, step_fn, mesh: Mesh, data_axis: str = "data"):
     masks are lists; every batch-leading tensor is sharded over the data
     axis; partial batches are zero-padded with padded rows excluded via the
     per-output label masks."""
-    repl = NamedSharding(mesh, P())
     n_shards = mesh.shape[data_axis]
+    # each process pads its LOCAL slice to its local share of the data axis
+    pad_multiple = max(n_shards // jax.process_count(), 1)
 
     jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     def wrapped(params, state, opt_state, it, inputs, labels, fmasks, lmasks,
                 rng):
         n = next(iter(inputs.values())).shape[0]
-        target = -(-n // n_shards) * n_shards
+        target = -(-n // pad_multiple) * pad_multiple
         if target != n:
             pad = target - n
 
@@ -134,7 +156,7 @@ def shard_step_multi(net, step_fn, mesh: Mesh, data_axis: str = "data"):
         fmasks = {k: shard_batch(mesh, data_axis, v) for k, v in fmasks.items()}
         if lmasks is not None:
             lmasks = [shard_batch(mesh, data_axis, m) for m in lmasks]
-        rng = jax.device_put(rng, repl)
+        rng = replicate(mesh, rng)
         return jitted(params, state, opt_state, it, inputs, labels, fmasks,
                       lmasks, rng)
 

@@ -1,0 +1,165 @@
+"""Multi-host (multi-process) distributed training — the DP-2 tier.
+
+Replaces the reference's Spark parameter-averaging scaleout
+(dl4j-spark/.../paramavg/ParameterAveragingTrainingMaster.java:358
+executeTraining: broadcast params -> workers fit local minibatches ->
+RDD.aggregate sums -> divide -> rebroadcast, §3.4) with the TPU-native
+single-controller model (SURVEY.md §5.8): every process calls
+``initialize()`` (jax.distributed), the device mesh spans ALL processes'
+devices, and the SAME jitted train step runs SPMD everywhere — XLA lowers
+the gradient all-reduce onto ICI within a host and DCN across hosts. There
+is no driver, no broadcast step, and no parameter copy per round: the
+"averaging" is the gradient psum inside the compiled step, every step.
+
+Data feeding: each process supplies its LOCAL slice of the global batch;
+``global_batch`` assembles the process-local arrays into one global jax
+Array sharded over the mesh's data axis
+(jax.make_array_from_process_local_data — the RDD-partition analogue).
+
+The exact-equivalence contract (TestCompareParameterAveragingSparkVs
+SingleMachine.java analogue) is pinned by
+tests/test_multihost.py: 2 spawned processes x 4 virtual CPU devices
+training on disjoint batch halves must produce params bit-identical to
+each other AND matching a single-process run on the full batch.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None):
+    """Bring up the multi-process runtime (jax.distributed.initialize).
+
+    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) so launchers can stay declarative;
+    on TPU pods with no args at all, jax autodetects the topology."""
+    kwargs = {}
+    if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = (
+            coordinator_address or os.environ["JAX_COORDINATOR_ADDRESS"])
+    if num_processes is not None or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(
+            num_processes if num_processes is not None
+            else os.environ["JAX_NUM_PROCESSES"])
+    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(
+            process_id if process_id is not None
+            else os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kwargs)
+    return process_info()
+
+
+def process_info():
+    return {"process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "local_devices": len(jax.local_devices()),
+            "global_devices": len(jax.devices())}
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def global_batch(mesh, data_axis: str, local_array):
+    """Assemble per-process local batch slices into one global Array
+    sharded over ``data_axis``. Every process passes its own slice; the
+    global leading dim is the sum over processes."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if local_array is None:
+        return None
+    local_array = np.asarray(local_array)
+    spec = P(data_axis) if local_array.ndim >= 1 else P()
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_array)
+
+
+def sync_check(tree) -> bool:
+    """Cross-process agreement check: True iff every process holds
+    bit-identical leaves (the params-stay-in-sync assertion the Spark
+    master enforced structurally by rebroadcasting; here it is a test/
+    debug utility because SPMD keeps them in sync by construction)."""
+    from jax.experimental import multihost_utils
+    leaves = jax.tree_util.tree_leaves(tree)
+    ok = True
+    for leaf in leaves:
+        arr = np.asarray(jax.device_get(leaf))
+        gathered = multihost_utils.process_allgather(arr)
+        ok = ok and bool(np.all(gathered == gathered[0]))
+    return ok
+
+
+class MultiProcessLocalSGD:
+    """DP-3 substitution: the reference's asynchronous Aeron parameter
+    server (deeplearning4j-scaleout-parallelwrapper-parameter-server/...
+    /ParameterServerParallelWrapper.java:161 spawns ParameterServerNode,
+    :208 workers push/pull over UDP).
+
+    Design decision (documented substitution): asynchronous push/pull
+    updates do not map onto the TPU SPMD model — there is no server to
+    push to, and XLA programs are bulk-synchronous. The TPU-native
+    equivalent with the same systems goal (decouple workers from
+    lock-step gradient exchange, trade staleness for communication) is
+    communication-avoiding LOCAL SGD: each process trains independently
+    on its local data for ``averaging_frequency`` steps with NO
+    cross-process traffic, then parameters (and optionally updater state)
+    are averaged across processes over DCN. averaging_frequency=1
+    degenerates to synchronous parameter averaging; larger values give
+    the parameter-server-style reduced communication pattern.
+
+    The net must NOT be meshed across processes (each process holds its
+    own replica — the PS-worker analogue).
+    """
+
+    def __init__(self, net, averaging_frequency: int = 1,
+                 average_updaters: bool = True):
+        if averaging_frequency < 1:
+            raise ValueError("averaging_frequency must be >= 1")
+        self.net = net
+        self.averaging_frequency = averaging_frequency
+        self.average_updaters = average_updaters
+        self._local_steps = 0
+
+    def _average_tree(self, tree):
+        from jax.experimental import multihost_utils
+
+        def avg(leaf):
+            gathered = multihost_utils.process_allgather(
+                np.asarray(jax.device_get(leaf)))
+            return jax.numpy.asarray(
+                np.mean(gathered, axis=0, dtype=np.float64).astype(
+                    np.asarray(leaf).dtype))
+
+        return jax.tree_util.tree_map(avg, tree)
+
+    def average_now(self):
+        """Cross-process parameter (+ updater-state) average — the
+        processResults aggregate/divide step
+        (ParameterAveragingTrainingMaster.java:851-877), as one DCN
+        all-gather + mean instead of a driver round-trip."""
+        self.net.params = self._average_tree(self.net.params)
+        if self.average_updaters and self.net.opt_state is not None:
+            self.net.opt_state = self._average_tree(self.net.opt_state)
+        return self.net
+
+    def fit_batch(self, ds):
+        score = self.net.fit_batch(ds)
+        self._local_steps += 1
+        if self._local_steps % self.averaging_frequency == 0:
+            self.average_now()
+        return score
+
+    def fit(self, iterator, *, epochs: int = 1):
+        for _ in range(epochs):
+            for ds in iterator:
+                self.fit_batch(ds)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        if self._local_steps % self.averaging_frequency != 0:
+            self.average_now()
+        return self.net

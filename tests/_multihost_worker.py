@@ -1,0 +1,83 @@
+"""Worker process for tests/test_multihost.py: one of N processes in a
+jax.distributed CPU cluster. Trains the shared fixed-seed MLP on its local
+slice of the global batch and dumps final params + a cross-process sync
+check. (The ExecuteWorkerFlatMap analogue — SURVEY.md §3.4 — except there
+is no driver: every process runs this same SPMD program.)"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+
+def build_net():
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.updater import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(123).updater(Sgd(0.1))
+            .dtype(DtypePolicy(param_dtype="float64",
+                               compute_dtype="float64"))
+            .list()
+            .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def global_data(n=32):
+    rng = np.random.default_rng(99)
+    x = rng.normal(0, 1, (n, 12))
+    y = np.eye(3)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def main():
+    coord, nproc, pid, out_path, steps = sys.argv[1:6]
+    mode = sys.argv[6] if len(sys.argv) > 6 else "spmd"
+    nproc, pid, steps = int(nproc), int(pid), int(steps)
+
+    from deeplearning4j_tpu.parallel import distributed
+    info = distributed.initialize(coord, nproc, pid)
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    net = build_net()
+    x, y = global_data()
+    # disjoint contiguous local slices, ordered by process id — together
+    # they form the same global batch the single-process reference uses
+    per = x.shape[0] // nproc
+    sl = slice(pid * per, (pid + 1) * per)
+    ds = DataSet(x[sl], y[sl])
+
+    if mode == "localsgd":
+        # DP-3 substitution: per-process replicas + periodic averaging
+        trainer = distributed.MultiProcessLocalSGD(net,
+                                                   averaging_frequency=2)
+        for _ in range(steps):
+            trainer.fit_batch(ds)
+    else:
+        mesh = make_mesh({"data": len(jax.devices())})
+        net.use_mesh(mesh)
+        for _ in range(steps):
+            net.fit_batch(ds)
+
+    in_sync = distributed.sync_check(net.params)
+    flat = {f"{ln}.{pn}": np.asarray(jax.device_get(arr))
+            for ln, sub in net.params.items() for pn, arr in sub.items()}
+    np.savez(out_path, __sync__=np.asarray(in_sync),
+             __info__=np.asarray([info["process_count"],
+                                  info["global_devices"]]), **flat)
+    print("WORKER_OK", pid, in_sync, flush=True)
+
+
+if __name__ == "__main__":
+    main()
