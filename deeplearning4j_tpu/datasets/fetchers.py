@@ -67,11 +67,15 @@ def _read_idx(path: str) -> np.ndarray:
     return data.reshape(dims)
 
 
-def _synthetic_images(classes, h, w, c, n, seed):
+def _synthetic_images(classes, h, w, c, n, seed, split_seed=0):
     """Per-class template + noise images in [0, 1] — separable, MNIST-like
-    statistics; deterministic in ``seed``."""
-    rng = np.random.default_rng(seed)
-    templates = rng.random((classes, h, w, c)).astype(np.float32)
+    statistics; deterministic in ``seed``. Templates depend ONLY on
+    ``seed`` so train/test splits (different ``split_seed``) share the
+    same class structure — otherwise a model trained on the synthetic
+    train split scores chance accuracy on the test split."""
+    templates = np.random.default_rng(seed).random(
+        (classes, h, w, c)).astype(np.float32)
+    rng = np.random.default_rng(seed * 7919 + split_seed + 1)
     labels = rng.integers(0, classes, n)
     x = templates[labels] + 0.35 * rng.standard_normal(
         (n, h, w, c)).astype(np.float32)
@@ -112,8 +116,8 @@ class MnistDataFetcher:
                 x, y = x[:num_examples], y[:num_examples]
             return DataSet(x, y), DataSetDescriptor("mnist", False, len(x))
         n = num_examples or (6000 if train else 1000)
-        x, y = _synthetic_images(10, 28, 28, 1, n,
-                                 seed + (0 if train else 1))
+        x, y = _synthetic_images(10, 28, 28, 1, n, seed,
+                                 split_seed=0 if train else 1)
         return DataSet(x, y), DataSetDescriptor("mnist(synthetic)", True, n)
 
 
@@ -148,8 +152,8 @@ class CifarDataFetcher:
                 x, y = x[:num_examples], y[:num_examples]
             return DataSet(x, y), DataSetDescriptor("cifar10", False, len(x))
         n = num_examples or (5000 if train else 1000)
-        x, y = _synthetic_images(10, 32, 32, 3, n,
-                                 seed + (0 if train else 1))
+        x, y = _synthetic_images(10, 32, 32, 3, n, seed,
+                                 split_seed=0 if train else 1)
         return DataSet(x, y), DataSetDescriptor("cifar10(synthetic)", True, n)
 
 

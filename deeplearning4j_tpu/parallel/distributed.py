@@ -66,19 +66,6 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
-def global_batch(mesh, data_axis: str, local_array):
-    """Assemble per-process local batch slices into one global Array
-    sharded over ``data_axis``. Every process passes its own slice; the
-    global leading dim is the sum over processes."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    if local_array is None:
-        return None
-    local_array = np.asarray(local_array)
-    spec = P(data_axis) if local_array.ndim >= 1 else P()
-    return jax.make_array_from_process_local_data(
-        NamedSharding(mesh, spec), local_array)
-
-
 def sync_check(tree) -> bool:
     """Cross-process agreement check: True iff every process holds
     bit-identical leaves (the params-stay-in-sync assertion the Spark
@@ -148,6 +135,11 @@ class MultiProcessLocalSGD:
         return self.net
 
     def fit_batch(self, ds):
+        """One local step; averages every ``averaging_frequency`` steps.
+        NOTE: the periodic average is a COLLECTIVE — when driving
+        fit_batch directly, every process must take the same number of
+        steps or the allgather deadlocks. ``fit`` handles uneven local
+        iterators itself."""
         score = self.net.fit_batch(ds)
         self._local_steps += 1
         if self._local_steps % self.averaging_frequency == 0:
@@ -155,8 +147,18 @@ class MultiProcessLocalSGD:
         return score
 
     def fit(self, iterator, *, epochs: int = 1):
+        """Epoch loop over a LOCAL iterator. Processes may hold uneven
+        batch counts (dataset not divisible by process count): each epoch
+        the common step count is agreed via one allgather and the extra
+        local batches are dropped, so every process performs the same
+        number of collectives (no deadlock)."""
+        from jax.experimental import multihost_utils
         for _ in range(epochs):
-            for ds in iterator:
+            batches = list(iterator)
+            counts = multihost_utils.process_allgather(
+                np.asarray(len(batches)))
+            n = int(np.min(counts))
+            for ds in batches[:n]:
                 self.fit_batch(ds)
             if hasattr(iterator, "reset"):
                 iterator.reset()

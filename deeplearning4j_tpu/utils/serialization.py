@@ -11,9 +11,10 @@ container is a ZIP holding:
 - ``metadata.json``      — step/epoch/format version (beyond the reference,
   which loses step count on restore — SURVEY.md §5.4)
 
-For large sharded models the orbax-based checkpointer (checkpoint.py) is the
-performance path; this ZIP format is the portable single-file format and the
-regression-test surface.
+This ZIP is the portable single-file format and the regression-test
+surface. Reference-written checkpoints (the Java stack's own zips) are
+read by modelimport/dl4j.py; sharded many-host checkpoints can use orbax
+directly on the param/opt pytrees (not wrapped here).
 """
 
 from __future__ import annotations

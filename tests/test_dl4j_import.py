@@ -1,0 +1,174 @@
+"""DL4J-zip checkpoint format tests (RegressionTest{050,060,071}.java
+analogue). No live Java stack exists in this environment, so fixtures are
+produced by the module's symmetric writer, which follows
+ModelSerializer.java:79-95 + the ParamInitializer view layouts line by
+line; these tests pin the binary format and the layout permutations."""
+
+import io
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.modelimport.dl4j import (
+    read_nd4j_array,
+    restore_multi_layer_network_from_dl4j,
+    write_dl4j_zip,
+    write_nd4j_array,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.conf.layers_conv import (BatchNorm, Convolution2D,
+                                                    Subsampling)
+from deeplearning4j_tpu.nn.conf.layers_recurrent import GravesLSTM, RnnOutput
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+
+
+class TestNd4jBinary:
+    def test_round_trip_2d(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = io.BytesIO()
+        write_nd4j_array(buf, a)
+        buf.seek(0)
+        b = read_nd4j_array(buf)
+        np.testing.assert_array_equal(a, b)
+
+    def test_double_round_trip(self):
+        a = np.random.default_rng(0).normal(size=(1, 7))
+        buf = io.BytesIO()
+        write_nd4j_array(buf, a, dtype="DOUBLE")
+        buf.seek(0)
+        np.testing.assert_array_equal(a, read_nd4j_array(buf))
+
+    def test_headerless_buffer_variant(self):
+        # some point releases omit the allocation-mode UTF; the reader must
+        # accept both
+        import struct
+        buf = io.BytesIO()
+        si = np.asarray([2, 2, 3, 3, 1, 0, 1, ord("c")], np.int64)
+
+        def utf(s):
+            b = s.encode()
+            return struct.pack(">H", len(b)) + b
+
+        for payload, tn in ((si, "INT"),
+                            (np.arange(6, dtype=np.float32), "FLOAT")):
+            buf.write(struct.pack(">i", payload.size))
+            buf.write(utf(tn))
+            dt = ">i4" if tn == "INT" else ">f4"
+            buf.write(payload.astype(dt).tobytes())
+        buf.seek(0)
+        out = read_nd4j_array(buf)
+        np.testing.assert_array_equal(
+            out, np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def _round_trip(net, tmp_path, input_type=None):
+    p = str(tmp_path / "model.zip")
+    write_dl4j_zip(net, p, dtype="DOUBLE")
+    return restore_multi_layer_network_from_dl4j(p, input_type=input_type,
+                                                 dtype=F64)
+
+
+class TestDl4jZipRoundTrip:
+    def test_mlp(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(1).dtype(F64).list()
+                .layer(Dense(n_in=6, n_out=5, activation="tanh"))
+                .layer(Output(n_in=5, n_out=3, activation="softmax",
+                              loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net2 = _round_trip(net, tmp_path)
+        x = np.random.default_rng(0).normal(size=(4, 6))
+        np.testing.assert_allclose(net.output(x), net2.output(x),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_cnn_with_bn(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(2).dtype(F64).list()
+                .layer(Convolution2D(n_out=4, kernel=(3, 3),
+                                     activation="identity"))
+                .layer(BatchNorm(activation="relu"))
+                .layer(Subsampling(kernel=(2, 2), stride=(2, 2),
+                                   pooling="max"))
+                .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # make BN state non-trivial before export
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(8, 8, 8, 2))
+        y = np.eye(3)[rng.integers(0, 3, 8)]
+        net.fit_batch(DataSet(x, y))
+        net2 = _round_trip(net, tmp_path,
+                           input_type=InputType.convolutional(8, 8, 2))
+        np.testing.assert_allclose(net.output(x), net2.output(x),
+                                   rtol=1e-10, atol=1e-10)
+
+    def test_lstm_gate_permutation(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(3).dtype(F64).list()
+                .layer(GravesLSTM(n_in=4, n_out=6, activation="tanh"))
+                .layer(RnnOutput(n_in=6, n_out=3, activation="softmax",
+                                 loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # non-zero peepholes so the peephole column mapping is exercised
+        import jax.numpy as jnp
+        p0 = dict(net.params["layer_0"])
+        p0["p"] = jnp.asarray(
+            np.random.default_rng(2).normal(size=(3, 6)))
+        net.params = {**net.params, "layer_0": p0}
+        net2 = _round_trip(net, tmp_path)
+        x = np.random.default_rng(0).normal(size=(2, 5, 4))
+        np.testing.assert_allclose(net.output(x), net2.output(x),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_era_variant_field_names(self, tmp_path):
+        # 0.7/0.8-era @class activation objects + nIn/nOut casing must parse
+        import json
+        import zipfile
+
+        from deeplearning4j_tpu.modelimport.dl4j import write_nd4j_array
+        rng = np.random.default_rng(5)
+        W = rng.normal(size=(4, 2))
+        b = rng.normal(size=(2,))
+        flat = np.concatenate([W.reshape(-1, order="F"), b]).reshape(1, -1)
+        confs = {"confs": [{"layer": {"output": {
+            "nIn": 4, "nOut": 2,
+            "activationFn": {
+                "@class": "org.nd4j.linalg.activations.impl."
+                          "ActivationSoftmax"},
+            "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl."
+                                 "LossMCXENT"},
+        }}}]}
+        p = str(tmp_path / "era.zip")
+        buf = io.BytesIO()
+        write_nd4j_array(buf, flat, dtype="DOUBLE")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(confs))
+            zf.writestr("coefficients.bin", buf.getvalue())
+        net = restore_multi_layer_network_from_dl4j(p, dtype=F64)
+        x = rng.normal(size=(3, 4))
+        expect = x @ W + b
+        e = np.exp(expect - expect.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(net.output(x), e / e.sum(axis=1,
+                                                            keepdims=True),
+                                   rtol=1e-10, atol=1e-10)
+
+    def test_param_count_mismatch_rejected(self, tmp_path):
+        import json
+        import zipfile
+        flat = np.zeros((1, 5), np.float32)
+        confs = {"confs": [{"layer": {"dense": {"nin": 4, "nout": 2,
+                                                "activation": "tanh"}}}]}
+        p = str(tmp_path / "bad.zip")
+        buf = io.BytesIO()
+        write_nd4j_array(buf, flat)
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(confs))
+            zf.writestr("coefficients.bin", buf.getvalue())
+        with pytest.raises(ValueError, match="holds 5 params"):
+            restore_multi_layer_network_from_dl4j(p)
