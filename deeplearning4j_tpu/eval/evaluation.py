@@ -41,23 +41,40 @@ class Evaluation:
         self.confusion: ConfusionMatrix | None = None
         if self.num_classes:
             self.confusion = ConfusionMatrix(self.num_classes)
+        self.predictions: list = []  # Prediction records (meta-aware eval)
 
     # ------------------------------------------------------------------ eval
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, meta=None):
         """Accumulate a batch. ``labels`` one-hot (or class indices),
-        ``predictions`` probabilities/scores [batch(, time), classes]."""
+        ``predictions`` probabilities/scores [batch(, time), classes].
+        ``meta`` (optional): per-example record metadata list — each
+        surviving example is recorded as a ``Prediction`` for
+        error-tracing (Evaluation.java eval-with-metadata /
+        meta/Prediction.java parity)."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        if meta is not None:
+            meta = list(meta)
+            if len(meta) != predictions.shape[0]:
+                raise ValueError(
+                    f"meta has {len(meta)} records for a batch of "
+                    f"{predictions.shape[0]} examples")
         if predictions.ndim == 3:  # time series -> flatten (mask-aware)
             b, t, c = predictions.shape
             predictions = predictions.reshape(b * t, c)
             labels = labels.reshape(b * t, -1)
+            if meta is not None:
+                meta = [m for m in meta for _ in range(t)]
             if mask is not None:
                 m = np.asarray(mask).reshape(b * t).astype(bool)
                 predictions, labels = predictions[m], labels[m]
+                if meta is not None:
+                    meta = [md for md, keep in zip(meta, m) if keep]
         elif mask is not None:
             m = np.asarray(mask).reshape(-1).astype(bool)
             predictions, labels = predictions[m], labels[m]
+            if meta is not None:
+                meta = [md for md, keep in zip(meta, m) if keep]
         if labels.ndim == 2 and labels.shape[1] > 1:
             actual = labels.argmax(axis=1)
             ncls = labels.shape[1]
@@ -75,6 +92,11 @@ class Evaluation:
             self.num_classes = ncls
             self.confusion = ConfusionMatrix(ncls)
         self.confusion.add(actual, predicted)
+        if meta is not None:
+            from deeplearning4j_tpu.eval.meta import Prediction
+            self.predictions.extend(
+                Prediction(int(a), int(p), md)
+                for a, p, md in zip(actual, predicted, meta))
 
     # --------------------------------------------------------------- metrics
     def _tp(self, c):
@@ -148,4 +170,18 @@ class Evaluation:
             self.num_classes = other.num_classes
             self.confusion = ConfusionMatrix(other.num_classes)
         self.confusion.matrix += other.confusion.matrix
+        self.predictions.extend(other.predictions)
         return self
+
+    # ----------------------------------------------- prediction metadata
+    def get_prediction_errors(self):
+        """Misclassified examples with their record metadata
+        (Evaluation.getPredictionErrors parity; requires eval(..., meta=))."""
+        return [p for p in self.predictions
+                if p.actual_class != p.predicted_class]
+
+    def get_predictions_by_actual_class(self, cls: int):
+        return [p for p in self.predictions if p.actual_class == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int):
+        return [p for p in self.predictions if p.predicted_class == cls]

@@ -1,0 +1,21 @@
+"""Per-example prediction metadata (eval/meta/Prediction.java parity):
+actual class, predicted class, and the caller-supplied record metadata
+object that produced the example (e.g. a filename or row id), so
+misclassified examples can be traced back to their source records."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class Prediction:
+    actual_class: int
+    predicted_class: int
+    record_meta_data: Any = None
+
+    def __str__(self):
+        return (f"Prediction(actualClass={self.actual_class},"
+                f"predictedClass={self.predicted_class},"
+                f"RecordMetaData={self.record_meta_data})")

@@ -43,7 +43,8 @@ from deeplearning4j_tpu.nn.conf.layers_conv import (
     Subsampling,
     ZeroPadding,
 )
-from deeplearning4j_tpu.nn.conf.layers_recurrent import GravesLSTM, RnnOutput
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    GravesLSTM, RnnOutput, TimeDistributedDense)
 from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForward
 from deeplearning4j_tpu.nn.conf.vertices import (
     ElementWiseVertex,
@@ -426,6 +427,52 @@ def _translate_layer(class_name: str, cfg: dict, ctx: _Ctx, *,
         out.append(_Translated(conf, name, _embedding_loader()))
         return out
 
+    if class_name in ("GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        # [b, t, f] -> [b, f] over the time axis (KerasLayer.java:225-230
+        # maps these to GlobalPoolingLayer)
+        pooling = "max" if "Max" in class_name else "avg"
+        out.append(_Translated(GlobalPooling(name=name, pooling=pooling),
+                               name))
+        return out
+
+    if class_name in ("TimeDistributedDense", "TimeDistributed"):
+        # Keras 1 TimeDistributedDense, or the Keras 2 TimeDistributed
+        # wrapper around a Dense (KerasLayer.java:206-212 maps both to
+        # DenseLayer; here a first-class per-timestep dense)
+        inner = cfg.get("layer")
+        if class_name == "TimeDistributed":
+            if not inner or inner.get("class_name") != "Dense":
+                raise KerasImportError(
+                    "TimeDistributed is only supported around Dense "
+                    f"(got {inner and inner.get('class_name')})")
+            dcfg = inner.get("config", {})
+        else:
+            dcfg = cfg
+        n_out = int(dcfg.get("units", dcfg.get("output_dim")))
+        act = _act(dcfg.get("activation", "linear"))
+        use_bias = bool(dcfg.get("use_bias", dcfg.get("bias", True)))
+        if is_output:
+            loss = ctx.loss or ("mcxent" if act == "softmax" else "mse")
+            conf = RnnOutput(name=name, n_out=n_out, activation=act,
+                             loss=loss, has_bias=use_bias)
+        else:
+            conf = TimeDistributedDense(name=name, n_out=n_out,
+                                        activation=act, has_bias=use_bias)
+        out.append(_Translated(conf, name, _dense_loader(None)))
+        return out
+
+    if class_name == "Masking":
+        # masking flows via the DataSet feature mask in this framework —
+        # the layer itself is shape-identity, but silently processing
+        # padded steps as data would diverge from the source model
+        import warnings
+        warnings.warn(
+            f"Keras Masking layer '{name}' imported as identity: supply "
+            "the padding pattern as a DataSet feature mask (features_mask) "
+            "or padded timesteps WILL be processed as real data",
+            UserWarning)
+        return out
+
     if class_name == "LSTM":
         n_out = int(cfg.get("units", cfg.get("output_dim")))
         act = _act(cfg.get("activation", "tanh"))
@@ -646,9 +693,27 @@ def import_keras_model_and_weights(
         if prev and prev[0] != name:
             alias[name] = prev[0]
 
-    g.set_outputs(*[alias.get(n, n) for n in
+    out_resolved = [alias.get(n, n) for n in
                     (e[0] if isinstance(e, (list, tuple)) else e
-                     for e in extras["output_layers"])])
+                     for e in extras["output_layers"])]
+    # KerasLoss parity (modelimport KerasLoss.java): an output that is not
+    # a loss-bearing layer (e.g. a merge vertex or bare activation) gets a
+    # terminal LossLayer with the training loss appended — identity
+    # activation, so inference outputs are unchanged but fit() works
+    final_outputs = []
+    for n in out_resolved:
+        vconf = g.get_vertex(n)
+        has_loss = hasattr(vconf, "loss") if vconf is not None else False
+        if has_loss:
+            final_outputs.append(n)
+        else:
+            loss_name = f"{n}_loss"
+            g.add_layer(loss_name,
+                        L.LossLayer(name=loss_name,
+                                    loss=ctx.loss or "mse",
+                                    activation="identity"), n)
+            final_outputs.append(loss_name)
+    g.set_outputs(*final_outputs)
     if input_types:
         g.set_input_types(*input_types)
     net = ComputationGraph(g.build()).init()

@@ -154,6 +154,11 @@ class GraphBuilder:
                    *inputs: str) -> "GraphBuilder":
         return self._add(name, vertex_conf, inputs)
 
+    def get_vertex(self, name: str):
+        """The layer/vertex config registered under ``name`` (or None) —
+        used by importers to inspect partially-built graphs."""
+        return self._vertices.get(name)
+
     def set_outputs(self, *names: str) -> "GraphBuilder":
         self._network_outputs = tuple(names)
         return self

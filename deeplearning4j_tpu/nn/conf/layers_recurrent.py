@@ -108,3 +108,22 @@ class LastTimeStep(BaseRecurrentConfig):
     def make_layer(self, input_type, global_conf, policy):
         from deeplearning4j_tpu.nn.layers.recurrent import LastTimeStepLayer
         return LastTimeStepLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class TimeDistributedDense(BaseRecurrentConfig):
+    """Per-timestep dense WITHOUT a loss head: [b, t, n_in] ->
+    [b, t, n_out]. The reference maps Keras' TimeDistributed(Dense) /
+    TimeDistributedDense onto DenseLayer behind shape preprocessors
+    (KerasLayer.java:206-212); here it is a first-class layer so the time
+    axis never round-trips through a flatten."""
+
+    layer_type = "time_distributed_dense"
+    has_bias: bool = True
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            TimeDistributedDenseLayer)
+        return TimeDistributedDenseLayer(self, input_type, global_conf,
+                                         policy)

@@ -173,6 +173,16 @@ class RnnOutputLayerImpl(Layer):
         return self.loss_fn.score(labels2, z2, self.activation_fn, m2)
 
 
+class TimeDistributedDenseLayer(RnnOutputLayerImpl):
+    """Per-timestep dense, no loss head (Keras TimeDistributed(Dense) /
+    the reference's KerasLayer.java:206-212 mapping)."""
+
+    def loss(self, *args, **kwargs):
+        raise ValueError(
+            "TimeDistributedDense has no loss head — use RnnOutput as the "
+            "terminal layer")
+
+
 class LastTimeStepLayer(Layer):
     """[b, t, f] -> [b, f]: last step, or last *unmasked* step per example
     (LastTimeStepVertex.java parity)."""

@@ -12,9 +12,10 @@ is no driver, no broadcast step, and no parameter copy per round: the
 "averaging" is the gradient psum inside the compiled step, every step.
 
 Data feeding: each process supplies its LOCAL slice of the global batch;
-``global_batch`` assembles the process-local arrays into one global jax
-Array sharded over the mesh's data axis
-(jax.make_array_from_process_local_data — the RDD-partition analogue).
+``parallel.data_parallel.shard_batch`` assembles the process-local arrays
+into one global sharded Array
+(jax.make_array_from_process_local_data — the RDD-partition analogue) —
+the meshed networks route through it automatically.
 
 The exact-equivalence contract (TestCompareParameterAveragingSparkVs
 SingleMachine.java analogue) is pinned by
@@ -154,11 +155,17 @@ class MultiProcessLocalSGD:
         number of collectives (no deadlock)."""
         from jax.experimental import multihost_utils
         for _ in range(epochs):
-            batches = list(iterator)
-            counts = multihost_utils.process_allgather(
-                np.asarray(len(batches)))
+            try:
+                local_n = len(iterator)
+                batches = iter(iterator)   # stream, prefetch-friendly
+            except TypeError:
+                batches = list(iterator)   # unsized: materialize to count
+                local_n = len(batches)
+            counts = multihost_utils.process_allgather(np.asarray(local_n))
             n = int(np.min(counts))
-            for ds in batches[:n]:
+            for i, ds in enumerate(batches):
+                if i >= n:
+                    break
                 self.fit_batch(ds)
             if hasattr(iterator, "reset"):
                 iterator.reset()

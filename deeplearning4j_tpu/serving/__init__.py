@@ -1,0 +1,13 @@
+"""Model serving (dl4j-streaming parity tier).
+
+The reference's serving story is a Camel route consuming Kafka and
+calling ``Model.output()``
+(dl4j-streaming/.../routes/DL4jServeRouteBuilder.java:27, route :64).
+SURVEY.md §7 sanctions the TPU-idiomatic substitution: a thin batched
+HTTP inference endpoint over the jitted ``output()`` — Kafka/Camel
+plumbing is environment integration, not framework capability.
+"""
+
+from deeplearning4j_tpu.serving.server import ModelServer, serve
+
+__all__ = ["ModelServer", "serve"]

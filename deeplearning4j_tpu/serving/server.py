@@ -1,0 +1,137 @@
+"""Batched HTTP inference server.
+
+Parity surface: DL4jServeRouteBuilder.java:27,64 (deserialize record ->
+``Model.output()`` -> publish). TPU-native design:
+
+- ONE jitted forward per padded batch-bucket: request batches are padded
+  up to the next power-of-two bucket (capped at ``max_batch``) so XLA
+  compiles a handful of shapes once instead of one program per request
+  size — then rows beyond the real batch are sliced off the reply.
+- Works for MultiLayerNetwork (single ``features`` array) and
+  ComputationGraph (list under ``inputs``; multi-output replies are
+  lists).
+
+Endpoints:
+- ``POST /predict``  {"features": [[...]]} or {"inputs": [[[...]], ...]}
+  -> {"predictions": ...}
+- ``GET /healthz``   liveness + model summary sizes
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+def _next_bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch) if n <= max_batch else n
+
+
+class ModelServer:
+    def __init__(self, net, host: str = "127.0.0.1", port: int = 9500,
+                 max_batch: int = 1024):
+        self.net = net
+        self.host = host
+        self.port = port
+        self.max_batch = max_batch
+        self._httpd = None
+        self._thread = None
+        self._lock = threading.Lock()
+        self._is_graph = hasattr(net, "conf") and hasattr(
+            net.conf, "network_inputs")
+
+    # ------------------------------------------------------------ inference
+    def predict(self, features):
+        """Pad to the bucket size, run the jitted forward, slice back.
+        ``features``: one array (sequential net) or list of arrays (graph).
+        Serialized under a lock — device execution is the shared
+        resource; HTTP threads queue here."""
+        many = isinstance(features, (list, tuple))
+        feats = [np.asarray(f, np.float32)
+                 for f in (features if many else [features])]
+        n = feats[0].shape[0]
+        bucket = _next_bucket(n, self.max_batch)
+        if bucket != n:
+            feats = [np.pad(f, [(0, bucket - n)] + [(0, 0)] * (f.ndim - 1))
+                     for f in feats]
+        with self._lock:
+            if self._is_graph:
+                out = self.net.output(*feats)
+            else:
+                out = self.net.output(feats[0])
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o)[:n] for o in out]
+        return np.asarray(out)[:n]
+
+    # -------------------------------------------------------------- server
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/healthz"):
+                    self._json({"status": "ok",
+                                "params": int(server.net.num_params()),
+                                "graph": server._is_graph})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                if not self.path.startswith("/predict"):
+                    self._json({"error": "not found"}, 404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n).decode())
+                    if "inputs" in payload:
+                        out = server.predict([np.asarray(a) for a in
+                                              payload["inputs"]])
+                    else:
+                        out = server.predict(np.asarray(payload["features"]))
+                    if isinstance(out, list):
+                        preds = [o.tolist() for o in out]
+                    else:
+                        preds = out.tolist()
+                    self._json({"predictions": preds})
+                except Exception as e:  # surface as a 400, keep serving
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def serve(net, host: str = "127.0.0.1", port: int = 9500,
+          max_batch: int = 1024) -> ModelServer:
+    """One-call serving entry point: ``serve(net).url`` is live."""
+    return ModelServer(net, host, port, max_batch).start()

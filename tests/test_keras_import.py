@@ -439,3 +439,146 @@ def test_wrong_shape_raises(tmp_path):
     with pytest.raises(KerasImportError, match="shape"):
         import_keras_sequential_model_and_weights(
             config, {"d1": [rng.normal(size=(4, 8)), np.zeros(8)]})
+
+
+def test_time_distributed_dense_keras2_wrapper(tmp_path):
+    """TimeDistributed(Dense) -> per-timestep dense (KerasLayer.java:206-212
+    parity), numpy-verified."""
+    rng = np.random.default_rng(11)
+    units, feats, t = 5, 4, 6
+    kernel = rng.normal(size=(feats, 4 * units))
+    recurrent = rng.normal(size=(units, 4 * units))
+    bias = rng.normal(size=(4 * units,))
+    Wt, bt = rng.normal(size=(units, 7)), rng.normal(size=(7,))
+    Wo, bo = rng.normal(size=(7, 3)), rng.normal(size=(3,))
+    config = seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "l1", "units": units, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": True,
+                    "batch_input_shape": [None, t, feats]}},
+        {"class_name": "TimeDistributed",
+         "config": {"name": "td", "layer": {
+             "class_name": "Dense",
+             "config": {"name": "td_inner", "units": 7,
+                        "activation": "relu"}}}},
+        {"class_name": "TimeDistributed",
+         "config": {"name": "td_out", "layer": {
+             "class_name": "Dense",
+             "config": {"name": "td_out_inner", "units": 3,
+                        "activation": "softmax"}}}},
+    ])
+    path = os.path.join(tmp_path, "td.h5")
+    write_keras_h5(path, config, {"l1": [kernel, recurrent, bias],
+                                  "td": [Wt, bt], "td_out": [Wo, bo]})
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(2, t, feats)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    seq = np_lstm(x, kernel, recurrent, bias, units)
+    h = np_relu(seq @ Wt + bt)
+    ref = np_softmax(h @ Wo + bo)
+    assert ours.shape == (2, t, 3)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_time_distributed_dense_keras1(tmp_path):
+    """Keras 1 'TimeDistributedDense' class name maps the same way."""
+    rng = np.random.default_rng(12)
+    units, feats, t = 4, 3, 5
+    kernel = rng.normal(size=(feats, 4 * units))
+    recurrent = rng.normal(size=(units, 4 * units))
+    bias = rng.normal(size=(4 * units,))
+    Wt, bt = rng.normal(size=(units, 2)), rng.normal(size=(2,))
+    config = seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "l1", "units": units, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": True,
+                    "batch_input_shape": [None, t, feats]}},
+        {"class_name": "TimeDistributedDense",
+         "config": {"name": "tdd", "output_dim": 2,
+                    "activation": "softmax"}},
+    ])
+    path = os.path.join(tmp_path, "td1.h5")
+    write_keras_h5(path, config, {"l1": [kernel, recurrent, bias],
+                                  "tdd": [Wt, bt]})
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(2, t, feats)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    seq = np_lstm(x, kernel, recurrent, bias, units)
+    ref = np_softmax(seq @ Wt + bt)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("cls,npfn", [
+    ("GlobalMaxPooling1D", lambda s: s.max(axis=1)),
+    ("GlobalAveragePooling1D", lambda s: s.mean(axis=1)),
+])
+def test_global_pooling_1d(tmp_path, cls, npfn):
+    """Global 1D pooling over time (KerasLayer.java:225-230 parity)."""
+    rng = np.random.default_rng(13)
+    units, feats, t = 4, 3, 5
+    kernel = rng.normal(size=(feats, 4 * units))
+    recurrent = rng.normal(size=(units, 4 * units))
+    bias = rng.normal(size=(4 * units,))
+    Wd, bd = rng.normal(size=(units, 2)), rng.normal(size=(2,))
+    config = seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "l1", "units": units, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": True,
+                    "batch_input_shape": [None, t, feats]}},
+        {"class_name": cls, "config": {"name": "gp"}},
+        {"class_name": "Dense",
+         "config": {"name": "d", "units": 2, "activation": "softmax"}},
+    ])
+    path = os.path.join(tmp_path, "gp1d.h5")
+    write_keras_h5(path, config, {"l1": [kernel, recurrent, bias],
+                                  "d": [Wd, bd]})
+    net = import_keras_sequential_model(path)
+    x = rng.normal(size=(2, t, feats)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    seq = np_lstm(x, kernel, recurrent, bias, units)
+    ref = np_softmax(npfn(seq) @ Wd + bd)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_loss_terminal_layer_for_vertex_output(tmp_path):
+    """A functional model whose output is an Add vertex gets a terminal
+    LossLayer appended (KerasLoss.java parity): inference output is
+    unchanged and the imported model is trainable."""
+    rng = np.random.default_rng(14)
+    Wa, ba = rng.normal(size=(3, 4)), rng.normal(size=(4,))
+    Wb, bb = rng.normal(size=(3, 4)), rng.normal(size=(4,))
+    config = {
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in",
+                 "config": {"name": "in", "batch_input_shape": [None, 3]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "da",
+                 "config": {"name": "da", "units": 4, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "db",
+                 "config": {"name": "db", "units": 4, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Add", "name": "sum",
+                 "config": {"name": "sum"},
+                 "inbound_nodes": [[["da", 0, 0, {}], ["db", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["sum", 0, 0]],
+        },
+    }
+    net = import_keras_model_and_weights(
+        config, {"da": [Wa, ba], "db": [Wb, bb]}, training_loss="mse")
+    x = rng.normal(size=(4, 3)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    ref = np_relu(x @ Wa + ba) + np_relu(x @ Wb + bb)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    # trainable: the appended LossLayer carries the training loss
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    y = rng.normal(size=(4, 4)).astype(np.float32)
+    s0 = float(net.fit_batch(MultiDataSet([x], [y])))
+    assert np.isfinite(s0)

@@ -1,0 +1,102 @@
+"""Serving endpoint tests (DL4jServeRouteBuilder.java substitution —
+SURVEY.md §7 / VERDICT round-2 ask #7)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import serve
+
+F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+
+
+def _mlp():
+    conf = (NeuralNetConfiguration.builder().seed(1).dtype(F64).list()
+            .layer(Dense(n_in=4, n_out=8, activation="tanh"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def test_serve_predict_matches_output():
+    net = _mlp()
+    server = serve(net, port=0)
+    try:
+        x = np.random.default_rng(0).normal(size=(3, 4))
+        got = _post(server.url + "/predict", {"features": x.tolist()})
+        expect = np.asarray(net.output(x.astype(np.float32)))
+        np.testing.assert_allclose(np.asarray(got["predictions"]), expect,
+                                   rtol=1e-5, atol=1e-6)
+        # dynamic batch: a different (non-bucket) size pads + slices right
+        x2 = np.random.default_rng(1).normal(size=(5, 4))
+        got2 = _post(server.url + "/predict", {"features": x2.tolist()})
+        assert np.asarray(got2["predictions"]).shape == (5, 3)
+        np.testing.assert_allclose(
+            np.asarray(got2["predictions"]),
+            np.asarray(net.output(x2.astype(np.float32))),
+            rtol=1e-5, atol=1e-6)
+    finally:
+        server.stop()
+
+
+def test_serve_graph_multi_input():
+    g = (NeuralNetConfiguration.builder().seed(2).dtype(F64)
+         .graph_builder().add_inputs("a", "b")
+         .add_layer("da", Dense(n_in=3, n_out=4, activation="tanh"), "a")
+         .add_layer("db", Dense(n_in=2, n_out=4, activation="tanh"), "b")
+         .add_vertex("sum", __import__(
+             "deeplearning4j_tpu.nn.conf.vertices",
+             fromlist=["ElementWiseVertex"]).ElementWiseVertex(op="add"),
+             "da", "db")
+         .add_layer("out", Output(n_in=4, n_out=2, activation="softmax",
+                                  loss="mcxent"), "sum")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    server = serve(net, port=0)
+    try:
+        rng = np.random.default_rng(3)
+        a, b = rng.normal(size=(3, 3)), rng.normal(size=(3, 2))
+        got = _post(server.url + "/predict",
+                    {"inputs": [a.tolist(), b.tolist()]})
+        expect = np.asarray(net.output(a.astype(np.float32),
+                                       b.astype(np.float32)))
+        np.testing.assert_allclose(np.asarray(got["predictions"]), expect,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        server.stop()
+
+
+def test_serve_health_and_errors():
+    net = _mlp()
+    server = serve(net, port=0)
+    try:
+        with urllib.request.urlopen(server.url + "/healthz", timeout=30) as r:
+            h = json.loads(r.read().decode())
+        assert h["status"] == "ok" and h["params"] > 0
+        # malformed request -> 400, server keeps serving
+        try:
+            _post(server.url + "/predict", {"bogus": 1})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        x = np.zeros((2, 4))
+        got = _post(server.url + "/predict", {"features": x.tolist()})
+        assert np.asarray(got["predictions"]).shape == (2, 3)
+    finally:
+        server.stop()
