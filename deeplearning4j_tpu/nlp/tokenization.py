@@ -159,3 +159,67 @@ class LabelAwareIterator:
 
     def reset(self):
         pass
+
+
+class CJKCharTokenizerFactory(DefaultTokenizerFactory):
+    """CJK-aware tokenizer: Han/Kana/Hangul runs are emitted as character
+    bigrams (plus single chars for length-1 runs); other runs tokenize as
+    whitespace/word tokens.
+
+    Substitution note (SURVEY.md §2.0/§2.6): the reference vendors the
+    Kuromoji Japanese morphological analyzer (deeplearning4j-nlp-japanese,
+    ~6.8k LoC) and UIMA/Korean annotator plug-ins — host-side text
+    plumbing with no TPU relevance. Character n-gram segmentation is the
+    standard analyzer-free baseline for CJK embedding training; a real
+    analyzer can be plugged in through this same TokenizerFactory seam
+    (the reference's own extension point)."""
+
+    _CJK = (
+        (0x3040, 0x30FF),   # hiragana + katakana
+        (0x4E00, 0x9FFF),   # CJK unified ideographs
+        (0x3400, 0x4DBF),   # CJK extension A
+        (0xAC00, 0xD7AF),   # hangul syllables
+        (0xF900, 0xFAFF),   # CJK compatibility ideographs
+    )
+
+    @classmethod
+    def _is_cjk(cls, ch: str) -> bool:
+        cp = ord(ch)
+        return any(lo <= cp <= hi for lo, hi in cls._CJK)
+
+    def create(self, text: str):
+        tokens: List[str] = []
+        run = []
+
+        def flush_run():
+            if not run:
+                return
+            s = "".join(run)
+            if len(s) == 1:
+                tokens.append(s)
+            else:
+                tokens.extend(s[i:i + 2] for i in range(len(s) - 1))
+            run.clear()
+
+        word = []
+
+        def flush_word():
+            if word:
+                tokens.append("".join(word))
+                word.clear()
+
+        for ch in text:
+            if self._is_cjk(ch):
+                flush_word()
+                run.append(ch)
+            elif ch.isspace() or not (ch.isalnum() or ch in "'-_"):
+                flush_run()
+                flush_word()
+            else:
+                flush_run()
+                word.append(ch)
+        flush_run()
+        flush_word()
+        tok = DefaultTokenizer("", self._pre)
+        tok._tokens = tokens
+        return tok

@@ -155,17 +155,18 @@ class MultiProcessLocalSGD:
         number of collectives (no deadlock)."""
         from jax.experimental import multihost_utils
         for _ in range(epochs):
-            try:
-                local_n = len(iterator)
-                batches = iter(iterator)   # stream, prefetch-friendly
-            except TypeError:
-                batches = list(iterator)   # unsized: materialize to count
-                local_n = len(batches)
-            counts = multihost_utils.process_allgather(np.asarray(local_n))
+            # materialize the local epoch: the agreed step count drives a
+            # COLLECTIVE schedule, so it must reflect what iteration
+            # actually yields — a sized iterator whose __len__ over-reports
+            # would deadlock the averaging allgather on one host. The
+            # memory cost is the price of collective-count safety here;
+            # use fit_batch directly with an externally agreed schedule
+            # for streaming-scale data.
+            batches = list(iterator)
+            counts = multihost_utils.process_allgather(
+                np.asarray(len(batches)))
             n = int(np.min(counts))
-            for i, ds in enumerate(batches):
-                if i >= n:
-                    break
+            for ds in batches[:n]:
                 self.fit_batch(ds)
             if hasattr(iterator, "reset"):
                 iterator.reset()

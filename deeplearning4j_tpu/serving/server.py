@@ -53,6 +53,11 @@ class ModelServer:
         Serialized under a lock — device execution is the shared
         resource; HTTP threads queue here."""
         many = isinstance(features, (list, tuple))
+        if many and not self._is_graph and len(features) != 1:
+            raise ValueError(
+                "this model takes ONE features array — use the "
+                '{"features": [...]} payload (the "inputs" list form is '
+                "for multi-input graphs)")
         feats = [np.asarray(f, np.float32)
                  for f in (features if many else [features])]
         n = feats[0].shape[0]

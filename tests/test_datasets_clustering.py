@@ -186,3 +186,67 @@ def test_kmeans_duplicate_points_more_clusters_than_distinct():
     x = np.array([[1.0, 1.0]] * 6 + [[2.0, 2.0]] * 2, np.float32)
     km = KMeansClustering(k=4, max_iterations=5, seed=0).fit(x)
     assert km.centroids.shape == (4, 2)
+
+
+class TestSPTree:
+    def test_com_and_counts(self):
+        from deeplearning4j_tpu.clustering.sptree import QuadTree, SPTree
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(50, 2))
+        t = QuadTree(pts)
+        assert t.n == 50
+        np.testing.assert_allclose(t.com, pts.mean(axis=0), rtol=1e-9)
+        with pytest.raises(ValueError):
+            QuadTree(rng.normal(size=(5, 3)))
+        t3 = SPTree(rng.normal(size=(30, 3)))
+        assert t3.n == 30
+
+    def test_theta_zero_matches_exact_repulsion(self):
+        # theta -> 0: the tree sum must equal the brute-force O(N^2) sum
+        from deeplearning4j_tpu.clustering.sptree import SPTree
+        rng = np.random.default_rng(1)
+        y = rng.normal(size=(40, 2))
+        tree = SPTree(y)
+        for i in (0, 7, 39):
+            neg, z = tree.non_edge_forces(y[i], i, theta=0.0)
+            diff = y[i] - np.delete(y, i, axis=0)
+            q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            np.testing.assert_allclose(z, q.sum(), rtol=1e-9)
+            np.testing.assert_allclose(neg, ((q * q)[:, None] * diff).sum(0),
+                                       rtol=1e-9, atol=1e-12)
+
+    def test_theta_half_approximates_exact(self):
+        from deeplearning4j_tpu.clustering.sptree import SPTree
+        rng = np.random.default_rng(2)
+        y = rng.normal(size=(120, 2)) * 3
+        tree = SPTree(y)
+        for i in (3, 60):
+            neg, z = tree.non_edge_forces(y[i], i, theta=0.5)
+            diff = y[i] - np.delete(y, i, axis=0)
+            q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            z_exact = q.sum()
+            neg_exact = ((q * q)[:, None] * diff).sum(0)
+            assert abs(z - z_exact) / z_exact < 0.05
+            assert np.linalg.norm(neg - neg_exact) <= (
+                0.1 * np.linalg.norm(neg_exact) + 1e-3)
+
+
+class TestBarnesHutTsne:
+    def test_separates_clusters_and_differs_from_alias(self):
+        """Real Barnes-Hut (theta=0.5) must separate well-separated
+        clusters — no longer a disclosed alias of the exact kernel."""
+        from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+        rng = np.random.default_rng(3)
+        a = rng.normal(0, 0.3, (30, 8)) + 4
+        b = rng.normal(0, 0.3, (30, 8)) - 4
+        x = np.concatenate([a, b])
+        ts = BarnesHutTsne(perplexity=10, max_iter=250, theta=0.5, seed=0,
+                           learning_rate=50.0)
+        y = ts.fit_transform(x)
+        assert y.shape == (60, 2)
+        da = y[:30].mean(axis=0)
+        db = y[30:].mean(axis=0)
+        within = max(np.linalg.norm(y[:30] - da, axis=1).mean(),
+                     np.linalg.norm(y[30:] - db, axis=1).mean())
+        between = np.linalg.norm(da - db)
+        assert between > 2 * within, (between, within)

@@ -234,3 +234,18 @@ def test_sequence_vectors_accepts_one_shot_generator():
     w2v.fit(s for s in corpus)  # generator, not a list
     vec = w2v.get_word_vector("alpha")
     assert vec is not None and np.isfinite(np.asarray(vec)).all()
+
+
+def test_cjk_char_tokenizer():
+    """Kuromoji/Korean add-on substitution: analyzer-free CJK character
+    bigrams through the reference's TokenizerFactory seam."""
+    from deeplearning4j_tpu.nlp.tokenization import CJKCharTokenizerFactory
+    f = CJKCharTokenizerFactory()
+    assert f.create("深層学習 deep learning です").get_tokens() == [
+        "深層", "層学", "学習", "deep", "learning", "です"]
+    assert f.create("한국어 x").get_tokens() == ["한국", "국어", "x"]
+    assert f.create("短 one").get_tokens() == ["短", "one"]
+    # preprocessor seam still applies
+    from deeplearning4j_tpu.nlp.tokenization import LowCasePreprocessor
+    f.set_token_pre_processor(LowCasePreprocessor())
+    assert f.create("ABC 語").get_tokens() == ["abc", "語"]
