@@ -156,6 +156,25 @@ class ComputationGraph:
         apply_mesh(self, mesh, data_axis)
         return self
 
+
+    def step_cost_analysis(self, mds) -> dict:
+        """XLA cost-model numbers for ONE compiled train step on this
+        batch shape: {"flops", "bytes_accessed"} (feeds
+        PerformanceListener(flops_per_step=...) for live MFU)."""
+        self._require_init()
+        mds = self._coerce(mds)
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        from deeplearning4j_tpu.utils.perf import xla_step_cost
+        inputs, fmasks = self._prepare_inputs(mds.features,
+                                              mds.features_masks)
+        labels = [jnp.asarray(l) for l in mds.labels]
+        it = jnp.asarray(self.iteration, jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        return xla_step_cost(self._train_step, self.params, self.state,
+                             self.opt_state, it, inputs, labels, fmasks,
+                             None, rng)
+
     def _require_init(self):
         if self.params is None:
             raise RuntimeError("Call init() before fit()/output()/evaluate()")

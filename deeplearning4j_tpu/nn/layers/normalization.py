@@ -55,9 +55,12 @@ class BatchNormLayer(Layer):
         if train:
             # custom-VJP op: single-pass f32 statistics, bf16-clean backward
             # (see ops/normalization.py; CudnnBatchNormalizationHelper.java
-            # is the reference's fused-kernel analogue)
+            # is the reference's fused-kernel analogue). The RUNNING mean
+            # is the variance-stabilization shift: data-independent (keeps
+            # the stats fused into the producing conv) and tracking the
+            # batch mean after warm-up
             xhat, mean, var = ops.get("batch_norm_train")(
-                x, gamma, beta, eps=c.eps)
+                x, gamma, beta, shift=state["mean"], eps=c.eps)
             d = c.decay
             sd = self.param_dtype
             new_state = {

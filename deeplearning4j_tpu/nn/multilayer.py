@@ -300,6 +300,22 @@ class MultiLayerNetwork:
         self.last_batch_examples = ds.num_examples
         return score
 
+
+    def step_cost_analysis(self, ds: DataSet) -> dict:
+        """XLA cost-model numbers for ONE compiled train step on this
+        batch shape: {"flops", "bytes_accessed"} (SURVEY.md §5.1 — feeds
+        PerformanceListener(flops_per_step=...) for live MFU)."""
+        self._require_init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        it = jnp.asarray(self.iteration, jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        from deeplearning4j_tpu.utils.perf import xla_step_cost
+        return xla_step_cost(self._train_step, self.params, self.state,
+                             self.opt_state, it, x, y, None, None, rng)
+
     def _require_init(self):
         if self.params is None:
             raise RuntimeError(

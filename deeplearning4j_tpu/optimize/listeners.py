@@ -65,15 +65,26 @@ class CollectScoresIterationListener(TrainingListener):
 
 class PerformanceListener(TrainingListener):
     """Throughput reporting (PerformanceListener parity: iterations/sec,
-    examples/sec, iteration wall time)."""
+    examples/sec, iteration wall time) + MFU when ``report_mfu`` is set:
+    per-step FLOPs come from XLA's cost model on the compiled train step
+    (SURVEY.md §5.1 — the reference has no MFU concept; the TPU framework
+    reports it first-class), peak from the device kind."""
 
-    def __init__(self, frequency: int = 10, report_examples: bool = True):
+    def __init__(self, frequency: int = 10, report_examples: bool = True,
+                 flops_per_step: float | None = None):
         self.frequency = max(1, frequency)
         self.report_examples = report_examples
+        self.flops_per_step = flops_per_step  # e.g. net.step_cost_analysis()
+        self.records: list[dict] = []
         self._last_time = None
         self._last_iter = None
         self._examples = 0
-        self.records: list[dict] = []
+
+    def _peak(self):
+        import jax
+
+        from deeplearning4j_tpu.utils.perf import peak_flops
+        return peak_flops(jax.devices()[0])
 
     def iteration_done(self, net, iteration, epoch):
         now = time.perf_counter()
@@ -97,6 +108,13 @@ class PerformanceListener(TrainingListener):
                 rec["examples_per_sec"] = (
                     self._examples / dt if dt > 0 else float("inf"))
                 msg += f", {rec['examples_per_sec']:.1f} examples/s"
+            if self.flops_per_step and dt > 0:
+                peak = self._peak()
+                if peak:
+                    mfu = self.flops_per_step * iters / dt / peak
+                    if 0.0 < mfu <= 1.0:  # never publish impossible MFU
+                        rec["mfu"] = mfu
+                        msg += f", MFU {100 * mfu:.1f}%"
             self.records.append(rec)
             logger.info(msg)
             self._last_time, self._last_iter = now, iteration

@@ -1,0 +1,37 @@
+"""Shared perf accounting: device peak FLOP/s table + XLA cost-model
+extraction. Single source of truth for bench.py, PerformanceListener, and
+the networks' ``step_cost_analysis`` (SURVEY.md §5.1)."""
+
+from __future__ import annotations
+
+# bf16 matmul peak FLOP/s by device kind prefix (public spec numbers)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,        # trillium
+}
+
+
+def peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def xla_step_cost(jitted_step, *args) -> dict:
+    """Cost-model numbers for one compiled call of ``jitted_step(*args)``:
+    {"flops", "bytes_accessed"}. Raises NotImplementedError for wrapped
+    (non-jit) steps such as the meshed trainers."""
+    if not hasattr(jitted_step, "lower"):
+        raise NotImplementedError(
+            "cost analysis needs a plain jitted step (meshed nets wrap it)")
+    cost = jitted_step.lower(*args).compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    cost = cost or {}
+    return {"flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0))}

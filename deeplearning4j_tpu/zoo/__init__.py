@@ -5,12 +5,16 @@ BASELINE.md measures — LeNet-MNIST, ResNet-50, GravesLSTM char-RNN)."""
 from deeplearning4j_tpu.zoo.models import (
     BF16,
     F32,
+    VGG16_MEAN_RGB,
     char_rnn,
     lenet,
     mnist_mlp,
     resnet18,
     resnet50,
+    vgg16,
+    vgg16_preprocess,
 )
 
-__all__ = ["BF16", "F32", "char_rnn", "lenet", "mnist_mlp", "resnet18",
-           "resnet50"]
+__all__ = ["BF16", "F32", "VGG16_MEAN_RGB", "char_rnn", "lenet",
+           "mnist_mlp", "resnet18", "resnet50", "vgg16",
+           "vgg16_preprocess"]

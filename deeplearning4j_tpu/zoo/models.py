@@ -197,3 +197,46 @@ def char_rnn(vocab_size: int = 80, hidden: int = 512, n_layers: int = 2,
             .set_input_type(InputType.recurrent(vocab_size))
             .build())
     return MultiLayerNetwork(conf).init()
+
+
+def vgg16(seed: int = 42, n_classes: int = 1000, image_size: int = 224,
+          dtype: Optional[DtypePolicy] = None,
+          updater=None) -> MultiLayerNetwork:
+    """VGG-16 (TrainedModels.java VGG16 parity: the reference ships the
+    architecture + preprocessing for its pretrained zoo entry
+    deeplearning4j-modelimport/.../trainedmodels/TrainedModels.java).
+    Pretrained ImageNet weights enter through the Keras importer
+    (modelimport/keras.py) — this builder provides the canonical
+    architecture; ``vgg16_preprocess`` the matching input pipeline."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(updater or Nesterovs(0.01, 0.9))
+         .dtype(dtype or BF16).activation("relu")
+         .list())
+    blocks = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    for n_convs, ch in blocks:
+        for _ in range(n_convs):
+            b = b.layer(Convolution2D(n_out=ch, kernel=(3, 3), mode="same",
+                                      activation="relu"))
+        b = b.layer(Subsampling(kernel=(2, 2), stride=(2, 2),
+                                pooling="max"))
+    conf = (b.layer(Dense(n_out=4096, activation="relu"))
+            .layer(Dense(n_out=4096, activation="relu"))
+            .layer(Output(n_out=n_classes, loss="mcxent",
+                          activation="softmax"))
+            .set_input_type(InputType.convolutional(image_size, image_size,
+                                                    3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# VGG16 mean-BGR preprocessing constants (TrainedModels.java
+# VGG16.getPreProcessor parity: subtract the ImageNet channel means)
+VGG16_MEAN_RGB = (123.68, 116.779, 103.939)
+
+
+def vgg16_preprocess(images):
+    """[b, h, w, 3] RGB uint8/float -> mean-subtracted float32 (the
+    reference's VGG16 pre-processor semantics, NHWC)."""
+    import numpy as np
+    x = np.asarray(images, np.float32)
+    return x - np.asarray(VGG16_MEAN_RGB, np.float32)

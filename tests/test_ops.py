@@ -162,7 +162,8 @@ class TestBatchNormTrainOp:
     def test_forward_matches_naive(self):
         from deeplearning4j_tpu.ops.normalization import batch_norm_train
         x, gamma, beta, eps = *self._data(), 1e-5
-        y, mean, var = batch_norm_train(x, gamma, beta, eps)
+        shift = jnp.zeros((x.shape[-1],))
+        y, mean, var = batch_norm_train(x, gamma, beta, shift, eps)
         np.testing.assert_allclose(y, self._naive(x, gamma, beta, eps),
                                    rtol=1e-9, atol=1e-9)
         axes = tuple(range(x.ndim - 1))
@@ -178,14 +179,31 @@ class TestBatchNormTrainOp:
         def loss_naive(x, g, b):
             return jnp.sum(jnp.sin(self._naive(x, g, b, eps)))
 
+        shift = jnp.full((x.shape[-1],), 0.7)  # any shift is exact
+
         def loss_mine(x, g, b):
-            y, _, _ = batch_norm_train(x, g, b, eps)
+            y, _, _ = batch_norm_train(x, g, b, shift, eps)
             return jnp.sum(jnp.sin(y))
 
         ref = jax.grad(loss_naive, argnums=(0, 1, 2))(x, gamma, beta)
         got = jax.grad(loss_mine, argnums=(0, 1, 2))(x, gamma, beta)
         for r, g in zip(ref, got):
             np.testing.assert_allclose(g, r, rtol=1e-7, atol=1e-9)
+
+    def test_large_mean_stability(self):
+        # |mean| >> std with the running-mean shift: the naive single-pass
+        # E[x^2]-E[x]^2 would lose the variance to cancellation
+        from deeplearning4j_tpu.ops.normalization import batch_norm_train
+        rng = np.random.default_rng(3)
+        x32 = jnp.asarray(
+            (5e3 + rng.normal(0, 1.0, (64, 8))).astype(np.float32))
+        shift = jnp.full((8,), 5e3, jnp.float32)
+        _, mean, var = batch_norm_train(x32, jnp.ones((8,), jnp.float32),
+                                        jnp.zeros((8,), jnp.float32),
+                                        shift, 1e-5)
+        np.testing.assert_allclose(np.asarray(var),
+                                   np.var(np.asarray(x32), axis=0),
+                                   rtol=1e-3)
 
 
 class TestEvalExtras:
@@ -250,3 +268,36 @@ class TestEvalExtras:
         txt = open(out).read()
         assert "AUC" in txt and "<svg" in txt and "polyline" in txt
         assert f"{roc.calculate_auc():.4f}" in txt
+
+
+class TestPerformanceListenerMfu:
+    def test_mfu_reported_with_flops(self):
+        from deeplearning4j_tpu.optimize import PerformanceListener
+        # tiny flops/step so mfu stays in (0, 1] regardless of how fast
+        # the fake iterations run (wall-clock dt is microseconds here)
+        pl = PerformanceListener(frequency=2, flops_per_step=1.0)
+        pl._peak = lambda: 1e12  # fixed peak regardless of device kind
+
+        class FakeNet:
+            last_batch_examples = 32
+            score_value = 0.5
+
+        net = FakeNet()
+        for it in range(1, 7):
+            pl.iteration_done(net, it, 0)
+        recs = [r for r in pl.records if "mfu" in r]
+        assert recs, pl.records
+        for r in recs:
+            assert 0 < r["mfu"] <= 1
+
+    def test_no_mfu_without_flops(self):
+        from deeplearning4j_tpu.optimize import PerformanceListener
+        pl = PerformanceListener(frequency=2)
+
+        class FakeNet:
+            last_batch_examples = 32
+            score_value = 0.5
+
+        for it in range(1, 5):
+            pl.iteration_done(FakeNet(), it, 0)
+        assert all("mfu" not in r for r in pl.records)

@@ -96,3 +96,18 @@ def test_fit_batch_repeated_graph():
     s = float(net.fit_batch_repeated(mds, 5))
     assert np.isfinite(s) and s < s0
     assert net.iteration == 6
+
+
+def test_vgg16_builds_and_runs_tiny():
+    """VGG-16 zoo entry (TrainedModels.java parity): structure + a forward
+    pass at a reduced image size (full 224 is bench territory)."""
+    import numpy as np
+    from deeplearning4j_tpu import zoo
+    net = zoo.vgg16(image_size=32, n_classes=7, dtype=zoo.F32)
+    # 13 convs + 5 pools + 2 dense + output = 21 layers
+    assert len(net.layers) == 21
+    x = zoo.vgg16_preprocess(
+        np.random.default_rng(0).integers(0, 255, (2, 32, 32, 3)))
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 7)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
