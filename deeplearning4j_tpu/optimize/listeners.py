@@ -74,7 +74,7 @@ class PerformanceListener(TrainingListener):
                  flops_per_step: float | None = None):
         self.frequency = max(1, frequency)
         self.report_examples = report_examples
-        self.flops_per_step = flops_per_step  # e.g. net.step_cost_analysis()
+        self.flops_per_step = flops_per_step  # net.step_cost_analysis(ds)["flops"]
         self.records: list[dict] = []
         self._last_time = None
         self._last_iter = None

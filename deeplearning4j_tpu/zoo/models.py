@@ -229,8 +229,8 @@ def vgg16(seed: int = 42, n_classes: int = 1000, image_size: int = 224,
     return MultiLayerNetwork(conf).init()
 
 
-# VGG16 mean-BGR preprocessing constants (TrainedModels.java
-# VGG16.getPreProcessor parity: subtract the ImageNet channel means)
+# VGG16 per-channel ImageNet means, RGB order (TrainedModels.java
+# VGG16.getPreProcessor parity: subtract these from RGB inputs)
 VGG16_MEAN_RGB = (123.68, 116.779, 103.939)
 
 
