@@ -57,7 +57,20 @@ def _find_file(name: str, filenames):
 
 
 def _read_idx(path: str) -> np.ndarray:
-    """Parse an IDX file (MnistManager parity), gzip-transparent."""
+    """Parse an IDX file (MnistManager parity), gzip-transparent. Plain
+    files go through the native C++ parser when the library is available
+    (native/dataloader.cpp — the DataVec-tier runtime); .gz and
+    lib-missing fall back to this Python path."""
+    if not path.endswith(".gz"):
+        try:
+            from deeplearning4j_tpu.datasets import native_io
+            if native_io.available():
+                # native reader returns normalized f32; callers here
+                # expect raw uint8 semantics, so request unnormalized
+                return native_io.read_idx(path, normalize=False).astype(
+                    np.uint8)
+        except Exception:
+            pass
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]

@@ -172,3 +172,42 @@ class IteratorDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         return iter(self._factory())
+
+
+class NativeDataSetIterator(DataSetIterator):
+    """DataSetIterator over the C++ prefetch loader
+    (datasets/native_io.py): shuffling, batch assembly and the depth-2
+    prefetch ring run in native worker threads — the DataVec-tier
+    substitution for the reference's off-JVM ingestion. Fallback is the
+    caller's job (use ArrayDataSetIterator when native_io.available() is
+    False)."""
+
+    def __init__(self, features, labels, batch_size: int,
+                 shuffle: bool = True, seed: int = 0, depth: int = 2,
+                 drop_last: bool = True):
+        from deeplearning4j_tpu.datasets.native_io import NativeBatchLoader
+        self._loader = NativeBatchLoader(
+            features, labels, batch_size, shuffle=shuffle, seed=seed,
+            depth=depth, drop_last=drop_last)
+        self._batch_size = self._loader.batch_size
+
+    def __iter__(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        for x, y in self._loader:
+            yield DataSet(x, y)
+
+    def __len__(self):
+        return self._loader.batches_per_epoch
+
+    def reset(self):
+        # restart the native stream (fresh epoch position + empty
+        # prefetch ring) — the DataSetIterator contract; a mid-epoch
+        # abandoned generator must not shift subsequent epochs
+        self._loader.reset()
+
+    @property
+    def batch_size(self):
+        return self._batch_size
+
+    def close(self):
+        self._loader.close()

@@ -1,0 +1,290 @@
+// Native data-loading runtime: IDX/CIFAR binary parsing + a threaded
+// prefetch ring.
+//
+// Parity: the reference delegates ingestion to the external DataVec
+// project and wraps it in AsyncDataSetIterator's background thread
+// (deeplearning4j-nn/.../datasets/iterator/AsyncDataSetIterator.java,
+// auto-wrap at MultiLayerNetwork.java:951); the actual byte parsing
+// (MnistManager.java IDX reads, CIFAR binary batches) runs on the JVM
+// heap. Here the parse + batch assembly + shuffle + normalization runs
+// in C++ worker threads that fill a bounded ring of pinned host buffers,
+// so the Python/JAX main loop only flips a ready flag and hands the
+// buffer to device transfer — the host-side input pipeline stays off the
+// interpreter entirely.
+//
+// C API (ctypes-consumed by deeplearning4j_tpu/datasets/native_io.py):
+//   dl4j_idx_read / dl4j_idx_free        one-shot IDX file -> float32
+//   dl4j_loader_open / _next / _close    prefetching batch loader
+//
+// Build: native/Makefile (g++ -O3 -fPIC -shared -pthread).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------- IDX parse
+struct IdxData {
+    std::vector<int64_t> dims;
+    std::vector<float> data;  // normalized to [0, 1] for u8 payloads
+};
+
+bool read_file(const char* path, std::vector<uint8_t>& out) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    out.resize(static_cast<size_t>(n));
+    size_t got = std::fread(out.data(), 1, out.size(), f);
+    std::fclose(f);
+    return got == out.size();
+}
+
+uint32_t be32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+bool parse_idx(const std::vector<uint8_t>& raw, IdxData& out,
+               bool normalize) {
+    if (raw.size() < 4) return false;
+    uint32_t magic = be32(raw.data());
+    uint32_t dtype = (magic >> 8) & 0xFF;
+    uint32_t ndim = magic & 0xFF;
+    if (raw.size() < 4 + 4 * size_t(ndim)) return false;
+    size_t total = 1;
+    out.dims.clear();
+    for (uint32_t i = 0; i < ndim; ++i) {
+        uint32_t d = be32(raw.data() + 4 + 4 * i);
+        out.dims.push_back(d);
+        // overflow-safe accumulate: corrupt headers must fail cleanly,
+        // not wrap small and pass the payload check
+        if (d != 0 && total > SIZE_MAX / d) return false;
+        total *= d;
+    }
+    const uint8_t* payload = raw.data() + 4 + 4 * ndim;
+    size_t avail = raw.size() - (4 + 4 * ndim);
+    // validate the payload BEFORE allocating header-claimed sizes
+    size_t elem = (dtype == 0x08) ? 1 : (dtype == 0x0D) ? 4 : 0;
+    if (elem == 0 || total > SIZE_MAX / elem || avail < total * elem)
+        return false;
+    out.data.resize(total);
+    if (dtype == 0x08) {  // unsigned byte (the MNIST case)
+        if (avail < total) return false;
+        float scale = normalize ? (1.0f / 255.0f) : 1.0f;
+        for (size_t i = 0; i < total; ++i)
+            out.data[i] = float(payload[i]) * scale;
+        return true;
+    }
+    if (dtype == 0x0D) {  // float32 big-endian
+        if (avail < total * 4) return false;
+        for (size_t i = 0; i < total; ++i) {
+            uint32_t v = be32(payload + 4 * i);
+            float f;
+            std::memcpy(&f, &v, 4);
+            out.data[i] = f;
+        }
+        return true;
+    }
+    return false;
+}
+
+// -------------------------------------------------------- prefetch ring
+struct Batch {
+    std::vector<float> x;
+    std::vector<float> y;
+    int64_t n = 0;  // examples in this batch
+};
+
+struct Loader {
+    // dataset (fully resident; MNIST/CIFAR scale)
+    std::vector<float> features;   // [n, feat]
+    std::vector<float> labels;     // [n, classes] one-hot
+    int64_t n_examples = 0, feat = 0, classes = 0, batch = 0;
+    bool drop_last = true;
+
+    // epoch order
+    std::vector<int64_t> order;
+    std::mt19937 rng;
+    bool shuffle = true;
+    size_t cursor = 0;
+
+    // ring
+    std::queue<Batch*> ready;
+    std::vector<Batch*> free_list;
+    std::mutex mu;
+    std::condition_variable cv_ready, cv_free;
+    std::thread worker;
+    std::atomic<bool> stop{false};
+
+    ~Loader() {
+        {
+            // the stop flag must flip under the mutex: a worker that has
+            // evaluated its wait predicate but not yet blocked would
+            // otherwise miss the notify and sleep forever (lost wakeup)
+            std::lock_guard<std::mutex> lk(mu);
+            stop.store(true);
+        }
+        cv_free.notify_all();
+        cv_ready.notify_all();
+        if (worker.joinable()) worker.join();
+        std::unique_lock<std::mutex> lk(mu);
+        while (!ready.empty()) { delete ready.front(); ready.pop(); }
+        for (Batch* b : free_list) delete b;
+    }
+
+    void reshuffle() {
+        if (shuffle) {
+            for (size_t i = order.size(); i > 1; --i) {
+                std::uniform_int_distribution<size_t> d(0, i - 1);
+                std::swap(order[i - 1], order[d(rng)]);
+            }
+        }
+        cursor = 0;
+    }
+
+    void fill(Batch* b) {
+        int64_t remaining = n_examples - int64_t(cursor);
+        int64_t take = remaining < batch ? remaining : batch;
+        if (take < batch && drop_last) {
+            reshuffle();
+            take = batch;
+        } else if (take <= 0) {
+            reshuffle();
+            take = batch < n_examples ? batch : n_examples;
+        }
+        b->n = take;
+        b->x.resize(size_t(take) * feat);
+        b->y.resize(size_t(take) * classes);
+        for (int64_t i = 0; i < take; ++i) {
+            int64_t src = order[cursor + size_t(i)];
+            std::memcpy(b->x.data() + i * feat,
+                        features.data() + src * feat, size_t(feat) * 4);
+            std::memcpy(b->y.data() + i * classes,
+                        labels.data() + src * classes, size_t(classes) * 4);
+        }
+        cursor += size_t(take);
+        if (cursor >= size_t(n_examples)) reshuffle();
+    }
+
+    void run() {
+        while (!stop.load()) {
+            Batch* b = nullptr;
+            {
+                std::unique_lock<std::mutex> lk(mu);
+                cv_free.wait(lk, [&] {
+                    return stop.load() || !free_list.empty();
+                });
+                if (stop.load()) return;
+                b = free_list.back();
+                free_list.pop_back();
+            }
+            fill(b);
+            {
+                std::unique_lock<std::mutex> lk(mu);
+                ready.push(b);
+            }
+            cv_ready.notify_one();
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// One-shot IDX read. Returns 0 on success; caller frees with
+// dl4j_idx_free. dims_out gets up to 8 dims, ndim_out the count,
+// data_out the malloc'd float32 buffer.
+int dl4j_idx_read(const char* path, int normalize, int64_t* dims_out,
+                  int32_t* ndim_out, float** data_out) try {
+    std::vector<uint8_t> raw;
+    if (!read_file(path, raw)) return 1;
+    IdxData idx;
+    if (!parse_idx(raw, idx, normalize != 0)) return 2;
+    if (idx.dims.size() > 8) return 3;
+    *ndim_out = int32_t(idx.dims.size());
+    for (size_t i = 0; i < idx.dims.size(); ++i) dims_out[i] = idx.dims[i];
+    float* buf = static_cast<float*>(
+        std::malloc(idx.data.size() * sizeof(float)));
+    if (!buf) return 4;
+    std::memcpy(buf, idx.data.data(), idx.data.size() * sizeof(float));
+    *data_out = buf;
+    return 0;
+} catch (...) {
+    // exceptions must never cross the C boundary into ctypes
+    return 5;
+}
+
+void dl4j_idx_free(float* p) { std::free(p); }
+
+// Prefetching loader over an in-memory dataset (features [n, feat] f32,
+// labels [n, classes] f32). Copies the arrays; ring of `depth` buffers.
+void* dl4j_loader_open(const float* features, const float* labels,
+                       int64_t n, int64_t feat, int64_t classes,
+                       int64_t batch, int32_t shuffle, int64_t seed,
+                       int32_t depth, int32_t drop_last) {
+    if (n <= 0 || feat <= 0 || classes <= 0 || batch <= 0 || depth <= 0)
+        return nullptr;
+    try {
+    Loader* L = new Loader();
+    L->features.assign(features, features + n * feat);
+    L->labels.assign(labels, labels + n * classes);
+    L->n_examples = n;
+    L->feat = feat;
+    L->classes = classes;
+    L->batch = batch < n ? batch : n;
+    L->drop_last = drop_last != 0;
+    L->shuffle = shuffle != 0;
+    L->rng.seed(static_cast<uint32_t>(seed));
+    L->order.resize(size_t(n));
+    for (int64_t i = 0; i < n; ++i) L->order[size_t(i)] = i;
+    L->reshuffle();
+    for (int32_t i = 0; i < depth; ++i) L->free_list.push_back(new Batch());
+    L->worker = std::thread(&Loader::run, L);
+    return L;
+    } catch (...) {
+        return nullptr;
+    }
+}
+
+// Blocks until a prefetched batch is ready, copies it into x_out/y_out
+// (caller-sized batch*feat / batch*classes), returns the example count.
+int64_t dl4j_loader_next(void* handle, float* x_out, float* y_out) {
+    Loader* L = static_cast<Loader*>(handle);
+    Batch* b = nullptr;
+    {
+        std::unique_lock<std::mutex> lk(L->mu);
+        L->cv_ready.wait(lk, [&] {
+            return L->stop.load() || !L->ready.empty();
+        });
+        if (L->stop.load() && L->ready.empty()) return -1;
+        b = L->ready.front();
+        L->ready.pop();
+    }
+    int64_t n = b->n;
+    std::memcpy(x_out, b->x.data(), b->x.size() * sizeof(float));
+    std::memcpy(y_out, b->y.data(), b->y.size() * sizeof(float));
+    {
+        std::unique_lock<std::mutex> lk(L->mu);
+        L->free_list.push_back(b);
+    }
+    L->cv_free.notify_one();
+    return n;
+}
+
+void dl4j_loader_close(void* handle) {
+    delete static_cast<Loader*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,174 @@
+"""Native data-loading runtime tests (native/dataloader.cpp + the ctypes
+binding). Skipped when the native toolchain/lib is unavailable — every
+consumer has a pure-Python fallback, so the native tier is additive."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import native_io
+
+pytestmark = pytest.mark.skipif(not native_io.available(),
+                                reason="native IO library unavailable")
+
+
+def _write_idx_u8(path, arr):
+    arr = np.asarray(arr, np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000800 | arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+
+
+def _write_idx_f32(path, arr):
+    arr = np.asarray(arr, np.float32)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000D00 | arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(">f4").tobytes())
+
+
+class TestNativeIdx:
+    def test_u8_matches_python_parser(self, tmp_path):
+        from deeplearning4j_tpu.datasets.fetchers import _read_idx
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 256, (7, 5, 4)).astype(np.uint8)
+        p = str(tmp_path / "t.idx")
+        _write_idx_u8(p, arr)
+        native = native_io.read_idx(p, normalize=False)
+        assert native.shape == arr.shape
+        np.testing.assert_array_equal(native.astype(np.uint8), arr)
+        # the fetcher path (which routes through native when available)
+        np.testing.assert_array_equal(_read_idx(p), arr)
+
+    def test_u8_normalized(self, tmp_path):
+        arr = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        p = str(tmp_path / "n.idx")
+        _write_idx_u8(p, arr)
+        out = native_io.read_idx(p, normalize=True)
+        np.testing.assert_allclose(out, arr / 255.0, rtol=1e-6)
+
+    def test_f32_big_endian(self, tmp_path):
+        rng = np.random.default_rng(1)
+        arr = rng.normal(size=(6, 3)).astype(np.float32)
+        p = str(tmp_path / "f.idx")
+        _write_idx_f32(p, arr)
+        np.testing.assert_allclose(native_io.read_idx(p), arr, rtol=1e-6)
+
+    def test_missing_file_raises(self):
+        with pytest.raises(RuntimeError):
+            native_io.read_idx("/nonexistent/file.idx")
+
+
+class TestNativeBatchLoader:
+    def test_covers_epoch_without_duplicates(self):
+        rng = np.random.default_rng(2)
+        n, feat, classes, bs = 64, 6, 3, 16
+        x = rng.normal(size=(n, feat)).astype(np.float32)
+        # embed the example id in feature 0 so batches are traceable
+        x[:, 0] = np.arange(n)
+        y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+        with native_io.NativeBatchLoader(x, y, bs, seed=7) as loader:
+            seen = []
+            for _ in range(loader.batches_per_epoch):
+                bx, by = loader.next_batch()
+                assert bx.shape == (bs, feat) and by.shape == (bs, classes)
+                ids = bx[:, 0].astype(int)
+                for i, row in zip(ids, bx):
+                    np.testing.assert_allclose(row, x[i], rtol=1e-6)
+                seen.extend(ids.tolist())
+            # one epoch covers each example exactly once (n % bs == 0)
+            assert sorted(seen) == list(range(n))
+
+    def test_labels_stay_aligned(self):
+        rng = np.random.default_rng(3)
+        n, feat, classes, bs = 40, 4, 5, 8
+        x = rng.normal(size=(n, feat)).astype(np.float32)
+        x[:, 0] = np.arange(n)
+        labels_idx = rng.integers(0, classes, n)
+        y = np.eye(classes, dtype=np.float32)[labels_idx]
+        with native_io.NativeBatchLoader(x, y, bs, seed=1) as loader:
+            for _ in range(2 * loader.batches_per_epoch):
+                bx, by = loader.next_batch()
+                ids = bx[:, 0].astype(int)
+                np.testing.assert_array_equal(by.argmax(axis=1),
+                                              labels_idx[ids])
+
+    def test_nd_features_reshaped(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(32, 5, 5, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        with native_io.NativeBatchLoader(x, y, 8) as loader:
+            bx, by = loader.next_batch()
+            assert bx.shape == (8, 5, 5, 2)
+
+    def test_iterator_trains_a_net(self):
+        """End-to-end: NativeDataSetIterator feeds MultiLayerNetwork.fit."""
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import NativeDataSetIterator
+        from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+        from deeplearning4j_tpu.nn.updater import Adam
+
+        rng = np.random.default_rng(5)
+        centers = rng.normal(0, 3.0, (3, 8))
+        idx = rng.integers(0, 3, 256)
+        x = (centers[idx] + rng.normal(0, 0.5, (256, 8))).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[idx]
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(5e-3))
+                .list()
+                .layer(Dense(n_in=8, n_out=16, activation="tanh"))
+                .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        it = NativeDataSetIterator(x, y, batch_size=64, seed=3)
+        try:
+            net.fit(it, epochs=8, async_prefetch=False)
+        finally:
+            it.close()
+        assert net.evaluate(DataSet(x, y)).accuracy() > 0.95
+
+
+class TestNativeLoaderReset:
+    def test_reset_restarts_epoch(self):
+        """Abandoning a mid-epoch generator then reset() must restart the
+        stream, not continue from a shifted position (DataSetIterator
+        contract)."""
+        n, feat, classes, bs = 32, 3, 2, 8
+        x = np.zeros((n, feat), np.float32)
+        x[:, 0] = np.arange(n)
+        y = np.eye(classes, dtype=np.float32)[np.zeros(n, int)]
+        with native_io.NativeBatchLoader(x, y, bs, shuffle=False,
+                                         seed=0) as loader:
+            first, _ = loader.next_batch()        # consume mid-epoch
+            loader.reset()
+            again, _ = loader.next_batch()
+            np.testing.assert_array_equal(first[:, 0], again[:, 0])
+
+    def test_next_after_close_raises(self):
+        x = np.zeros((8, 2), np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        loader = native_io.NativeBatchLoader(x, y, 4)
+        loader.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            loader.next_batch()
+
+    def test_corrupt_idx_fails_cleanly(self, tmp_path):
+        # header claims absurd dims; the native parser must return an
+        # error code, not crash the process
+        p = str(tmp_path / "corrupt.idx")
+        with open(p, "wb") as f:
+            f.write(struct.pack(">I", 0x00000803))
+            f.write(struct.pack(">I", 0xFFFFFFFF) * 3)
+            f.write(b"\x00" * 16)
+        with pytest.raises(RuntimeError):
+            native_io.read_idx(p)
+        # the fetcher path falls back to the python parser, which raises
+        # its own error for the truncated payload — but must not abort
+        from deeplearning4j_tpu.datasets.fetchers import _read_idx
+        with pytest.raises(Exception):
+            _read_idx(p)
