@@ -6,5 +6,6 @@ from deeplearning4j_tpu.optimize.listeners import (
     TrainingListener,
     ScoreIterationListener,
     PerformanceListener,
+    ProfilerListener,
     CollectScoresIterationListener,
 )

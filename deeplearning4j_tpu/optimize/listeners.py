@@ -136,3 +136,56 @@ class ComposableIterationListener(TrainingListener):
     def on_epoch_end(self, net):
         for l in self.listeners:
             l.on_epoch_end(net)
+
+
+class ProfilerListener(TrainingListener):
+    """Captures a JAX/XLA profiler trace for a window of training
+    iterations (SURVEY.md §5.1: the reference has only wall-clock
+    listeners; the TPU framework exposes the real profiler). The trace
+    (xplane.pb) lands in ``log_dir`` and opens with xprof/tensorboard;
+    PERF.md documents the in-repo parsing recipe."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 5,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = max(1, num_iterations)
+        self._active = False
+        self.captured = False
+        import atexit
+        # the JAX trace is process-wide: if training ends mid-window
+        # (short fit_batch loop, exception inside fit), the trace must
+        # still be flushed or it is silently lost AND blocks any later
+        # start_trace in this process
+        atexit.register(self.close)
+
+    def _stop(self, net):
+        import jax
+        # sync so the trace includes the in-flight device work
+        if net is not None and net.score_value is not None:
+            try:
+                float(net.score_value)
+            except Exception:
+                pass
+        jax.profiler.stop_trace()
+        self._active = False
+        self.captured = True
+
+    def close(self, net=None):
+        """Flush the trace if still recording (safe to call anytime)."""
+        if self._active:
+            self._stop(net)
+
+    def iteration_done(self, net, iteration, epoch):
+        import jax
+        if (not self.captured and not self._active
+                and iteration >= self.start_iteration):
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._stop_at = iteration + self.num_iterations
+            return
+        if self._active and iteration >= self._stop_at:
+            self._stop(net)
+
+    def on_epoch_end(self, net):
+        self.close(net)  # epoch shorter than the window: flush cleanly

@@ -301,3 +301,28 @@ class TestPerformanceListenerMfu:
         for it in range(1, 5):
             pl.iteration_done(FakeNet(), it, 0)
         assert all("mfu" not in r for r in pl.records)
+
+
+def test_profiler_listener_captures_trace(tmp_path):
+    """ProfilerListener writes an xplane trace for its iteration window."""
+    import glob
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.optimize import ProfilerListener
+
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Dense(n_in=4, n_out=8, activation="tanh"))
+            .layer(Output(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pl = ProfilerListener(str(tmp_path), start_iteration=2,
+                          num_iterations=2)
+    net.set_listeners(pl)
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(16, 4)), np.eye(2)[rng.integers(0, 2, 16)])
+    for _ in range(8):
+        net.fit_batch(ds)
+    assert pl.captured
+    assert glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
