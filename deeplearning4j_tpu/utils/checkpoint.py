@@ -1,0 +1,144 @@
+"""Sharded checkpoint/resume via orbax — the distributed tier.
+
+Parity+: the reference's ModelSerializer zip (util/ModelSerializer.java,
+covered by utils/serialization.py) is a single-file, single-process
+format whose Spark master holds the only parameter copy (SURVEY.md §5.4
+"no distributed checkpoint"). The TPU-native story (§5.3: preemption-
+resume IS the fault-tolerance answer) is an orbax checkpoint of
+{config JSON, param/state/opt pytrees, step, epoch}: every process
+writes its own parameter shards in parallel, and restore re-shards onto
+whatever mesh the restoring run provides — a multi-host run can resume
+on a different topology.
+
+Use::
+
+    from deeplearning4j_tpu.utils.checkpoint import (
+        save_checkpoint, restore_multi_layer_network,
+        restore_computation_graph)
+
+    save_checkpoint(net, "/ckpt/step_1000")          # any net, meshed or not
+    net = restore_multi_layer_network("/ckpt/step_1000")
+    net = restore_computation_graph("/ckpt/step_1000", mesh=my_mesh)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+
+_CKPTR = None
+
+
+def _checkpointer():
+    # one cached async checkpointer: constructing per call would spawn a
+    # fresh background worker thread each save in a periodic-save loop
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def _net_kind(net) -> str:
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    return "graph" if isinstance(net, ComputationGraph) else "multilayer"
+
+
+def save_checkpoint(net, path: str):
+    """Write {config, params, state, opt_state, step, epoch} under
+    ``path`` (a directory). In a multi-process runtime every process must
+    call this (orbax coordinates the parallel shard writes).
+
+    Crash-safety: the tree commit is atomic (orbax) and meta.json lands
+    via rename AFTER it, so a preempted save leaves either a complete
+    checkpoint or one missing meta.json (detected at restore). Write each
+    periodic save to a FRESH step directory (``.../step_1000`` as in the
+    module example) — overwriting one path in place cannot be made
+    crash-atomic across the two commits."""
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    tree = {"params": net.params, "state": net.state or {},
+            "opt_state": net.opt_state}
+    ckptr.save(os.path.join(path, "tree"), tree, force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        meta = {
+            "kind": _net_kind(net),
+            "config": net.conf.to_json(),
+            "iteration": int(net.iteration),
+            "epoch": int(net.epoch),
+            "format_version": 1,
+        }
+        tmp = os.path.join(path, ".meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(path, "meta.json"))
+    return path
+
+
+def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data"):
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta["kind"] != expect_kind:
+        raise ValueError(
+            f"checkpoint at {path} holds a {meta['kind']} net, not a "
+            f"{expect_kind}")
+
+    if expect_kind == "graph":
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = ComputationGraphConfiguration.from_json(meta["config"])
+        net = ComputationGraph(conf).init(structure_only=True)
+    else:
+        from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = MultiLayerConfiguration.from_json(meta["config"])
+        net = MultiLayerNetwork(conf).init(structure_only=True)
+
+    # target structure from the (structure-only) init; restore re-shards
+    # onto the requested mesh (replicated params) or host memory
+    target = {"params": net.params, "state": net.state or {},
+              "opt_state": net.opt_state}
+
+    def as_restore_type(x):
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sharding = NamedSharding(mesh, P())
+        else:
+            # explicit local placement: falling back to the sharding
+            # recorded in the checkpoint would break cross-topology
+            # resume (saved on 8 devices, restored on 1)
+            from jax.sharding import SingleDeviceSharding
+            sharding = SingleDeviceSharding(jax.local_devices()[0])
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    abstract = jax.tree_util.tree_map(as_restore_type, target)
+    ckptr = _checkpointer()
+    tree = ckptr.restore(os.path.join(path, "tree"), abstract)
+
+    net.params = tree["params"]
+    net.state = tree["state"]
+    net.opt_state = tree["opt_state"]
+    net.iteration = int(meta["iteration"])
+    net.epoch = int(meta["epoch"])
+    if mesh is not None:
+        net.use_mesh(mesh, data_axis)
+    return net
+
+
+def restore_multi_layer_network(path: str, mesh=None, data_axis="data"):
+    """Resume a sequential net (+ optionally place it on ``mesh``)."""
+    return _restore(path, "multilayer", mesh, data_axis)
+
+
+def restore_computation_graph(path: str, mesh=None, data_axis="data"):
+    """Resume a DAG net (+ optionally place it on ``mesh``)."""
+    return _restore(path, "graph", mesh, data_axis)
