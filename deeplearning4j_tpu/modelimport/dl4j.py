@@ -413,12 +413,16 @@ def _export_layer(net, layer, lc):
     s = {k: np.asarray(v, np.float64)
          for k, v in net.state.get(layer.name, {}).items()}
     t = lc.layer_type
+    # per-layer activation may be None (inherited from the global conf) —
+    # the zip must carry the RESOLVED value or the restored net silently
+    # runs identity activations
+    act = layer.resolve("activation", "identity")
 
     if t in ("dense", "output", "rnn_output"):
         kind = {"dense": "dense", "output": "output",
                 "rnn_output": "rnnoutput"}[t]
         ld = {"nin": int(lc.n_in), "nout": int(lc.n_out),
-              "activation": lc.activation or "identity"}
+              "activation": act}
         if t != "dense":
             ld["lossFunction"] = (lc.loss or "mcxent").upper()
         seg = np.concatenate([p["W"].reshape(-1, order="F"),
@@ -431,7 +435,7 @@ def _export_layer(net, layer, lc):
               "kernelSize": [kh, kw], "stride": list(lc.stride),
               "padding": list(lc.padding),
               "convolutionMode": lc.mode.capitalize(),
-              "activation": lc.activation or "identity"}
+              "activation": act}
         W = p["W"].transpose(3, 2, 0, 1)  # HWIO -> OIHW
         seg = np.concatenate([p["b"].reshape(-1), W.reshape(-1, order="C")])
         return "convolution", ld, seg
@@ -444,7 +448,7 @@ def _export_layer(net, layer, lc):
     if t == "batch_norm":
         f = p["gamma"].shape[0]
         ld = {"nin": f, "nout": f, "eps": lc.eps, "decay": lc.decay,
-              "activation": lc.activation or "identity"}
+              "activation": act}
         seg = np.concatenate([p["gamma"], p["beta"], s["mean"], s["var"]])
         return "batchNormalization", ld, seg
 
@@ -462,13 +466,13 @@ def _export_layer(net, layer, lc):
         RW = np.concatenate([RW4, peep], axis=1)
         b = degate(p["b"].reshape(1, -1))[0]
         ld = {"nin": int(lc.n_in), "nout": nL,
-              "activation": lc.activation or "tanh",
+              "activation": layer.resolve("activation", "tanh"),
               "gateActivationFn": lc.gate_activation}
         seg = np.concatenate([Wx.reshape(-1, order="F"),
                               RW.reshape(-1, order="F"), b])
         return "gravesLSTM", ld, seg
 
     if t == "activation":
-        return "activation", {"activation": lc.activation or "identity"}, None
+        return "activation", {"activation": act}, None
 
     raise ValueError(f"DL4J-zip export: unsupported layer type '{t}'")
