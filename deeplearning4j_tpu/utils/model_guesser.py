@@ -59,13 +59,14 @@ def load_model(path: str, **kwargs):
             restore_multi_layer_network_from_dl4j)
         return restore_multi_layer_network_from_dl4j(path, **kwargs)
     if fmt == "keras_h5":
-        import h5py
+        from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
         from deeplearning4j_tpu.modelimport.keras import (
             import_keras_model, import_keras_sequential_model)
-        with h5py.File(path, "r") as f:
-            cfg = f.attrs["model_config"]
-        cfg = cfg.decode() if isinstance(cfg, bytes) else cfg
-        cls = json.loads(cfg).get("class_name")
+        archive = Hdf5Archive(path)
+        try:
+            cls = (archive.model_config() or {}).get("class_name")
+        finally:
+            archive.close()
         return (import_keras_sequential_model(path, **kwargs)
                 if cls == "Sequential" else import_keras_model(path, **kwargs))
     # orbax

@@ -172,3 +172,28 @@ class TestDl4jZipRoundTrip:
             zf.writestr("coefficients.bin", buf.getvalue())
         with pytest.raises(ValueError, match="holds 5 params"):
             restore_multi_layer_network_from_dl4j(p)
+
+
+def test_inherited_global_activation_round_trips(tmp_path):
+    """Regression (round-3 bug): layers inheriting the NETWORK-wide
+    activation (per-layer activation=None) must export the RESOLVED
+    activation, not 'identity'."""
+    conf = (NeuralNetConfiguration.builder().seed(11).dtype(F64)
+            .activation("relu")  # global default; layers leave it unset
+            .list()
+            .layer(Dense(n_in=5, n_out=8))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    p = str(tmp_path / "glob.zip")
+    write_dl4j_zip(net, p, dtype="DOUBLE")
+    # the exported JSON must carry the resolved 'relu'
+    import json
+    import zipfile
+    with zipfile.ZipFile(p) as zf:
+        confs = json.loads(zf.read("configuration.json"))["confs"]
+    assert confs[0]["layer"]["dense"]["activation"] == "relu"
+    net2 = restore_multi_layer_network_from_dl4j(p, dtype=F64)
+    x = np.random.default_rng(3).normal(size=(4, 5))
+    np.testing.assert_allclose(net.output(x), net2.output(x),
+                               rtol=1e-12, atol=1e-12)
