@@ -1,5 +1,8 @@
-"""Unit tests for activations, losses, initializers (the ND4J-parity op
-sets; SURVEY.md §1 L0)."""
+"""Unit tests for the op layer and small host-side units: activations,
+losses, initializers (the ND4J-parity op sets, SURVEY.md §1 L0), sequence
+masking helpers, the custom-VJP batch-norm op, evaluation extras
+(Prediction metadata, HTML reports, distributed merge), and the
+performance/profiler listeners."""
 
 import jax
 import jax.numpy as jnp
@@ -326,3 +329,29 @@ def test_profiler_listener_captures_trace(tmp_path):
         net.fit_batch(ds)
     assert pl.captured
     assert glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
+
+
+def test_evaluation_merge_distributed_reduction():
+    """Evaluation.merge is the distributed eval reduction
+    (spark IEvaluateFlatMapFunction result merging parity): merged
+    accumulators must equal single-pass evaluation, predictions included."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    rng = np.random.default_rng(0)
+    labels = np.eye(3)[rng.integers(0, 3, 60)]
+    preds = rng.dirichlet(np.ones(3), 60)
+
+    whole = Evaluation()
+    whole.eval(labels, preds, meta=list(range(60)))
+
+    parts = Evaluation()
+    for lo in range(0, 60, 20):  # three "workers"
+        w = Evaluation()
+        w.eval(labels[lo:lo + 20], preds[lo:lo + 20],
+               meta=list(range(lo, lo + 20)))
+        parts.merge(w)
+
+    np.testing.assert_array_equal(parts.confusion.matrix,
+                                  whole.confusion.matrix)
+    assert parts.accuracy() == whole.accuracy()
+    assert ([p.record_meta_data for p in parts.get_prediction_errors()]
+            == [p.record_meta_data for p in whole.get_prediction_errors()])
