@@ -27,10 +27,15 @@ import numpy as np
 
 
 def _next_bucket(n: int, max_batch: int) -> int:
+    """Power-of-two bucket, capped at ``max_batch``. Requests larger than
+    ``max_batch`` are CHUNKED by the caller (never compiled at raw size —
+    one oversized POST must not grow the XLA compile cache; the reference
+    route consumes any-size payloads the same way,
+    DL4jServeRouteBuilder.java:64)."""
     b = 1
     while b < n:
         b *= 2
-    return min(b, max_batch) if n <= max_batch else n
+    return min(b, max_batch)
 
 
 class ModelServer:
@@ -43,12 +48,19 @@ class ModelServer:
         self._httpd = None
         self._thread = None
         self._lock = threading.Lock()
+        # every distinct padded batch shape handed to the device — the
+        # compile count is bounded by len(shapes_seen) (asserted by the
+        # serving concurrency test)
+        self.shapes_seen: set[int] = set()
         self._is_graph = hasattr(net, "conf") and hasattr(
             net.conf, "network_inputs")
 
     # ------------------------------------------------------------ inference
     def predict(self, features):
         """Pad to the bucket size, run the jitted forward, slice back.
+        Requests larger than ``max_batch`` are split into ``max_batch``
+        chunks so they reuse the already-compiled full-bucket program
+        instead of compiling a fresh XLA executable of arbitrary shape.
         ``features``: one array (sequential net) or list of arrays (graph).
         Serialized under a lock — device execution is the shared
         resource; HTTP threads queue here."""
@@ -61,10 +73,23 @@ class ModelServer:
         feats = [np.asarray(f, np.float32)
                  for f in (features if many else [features])]
         n = feats[0].shape[0]
+        if n > self.max_batch:
+            chunks = [self._predict_bucketed(
+                          [f[i:i + self.max_batch] for f in feats])
+                      for i in range(0, n, self.max_batch)]
+            if isinstance(chunks[0], list):
+                return [np.concatenate([c[k] for c in chunks])
+                        for k in range(len(chunks[0]))]
+            return np.concatenate(chunks)
+        return self._predict_bucketed(feats)
+
+    def _predict_bucketed(self, feats):
+        n = feats[0].shape[0]
         bucket = _next_bucket(n, self.max_batch)
         if bucket != n:
             feats = [np.pad(f, [(0, bucket - n)] + [(0, 0)] * (f.ndim - 1))
                      for f in feats]
+        self.shapes_seen.add(bucket)
         with self._lock:
             if self._is_graph:
                 out = self.net.output(*feats)
