@@ -30,7 +30,6 @@ dWx/db/dx are recovered by the caller with dense matmuls.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
@@ -81,8 +80,7 @@ def lstm_sequence_xla(xz_t, h0, c0, Wh, p, mask_t, *, gate_act="sigmoid",
 
 
 # --------------------------------------------------------------- pallas
-def _interpret():
-    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "0") == "1"
+_interpret = registry.pallas_interpret
 
 
 def _pallas_supported(xz_t, h0, gate_act, cell_act):

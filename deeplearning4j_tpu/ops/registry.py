@@ -34,6 +34,14 @@ _DEFAULT_ORDER = ("pallas", "xla") if os.environ.get(
 _order = list(_DEFAULT_ORDER)
 
 
+def pallas_interpret() -> bool:
+    """Shared interpret-mode switch for every pallas backend (set
+    DL4J_TPU_PALLAS_INTERPRET=1 to run the hand kernels through the
+    Pallas interpreter off-TPU — how the equivalence tests exercise them
+    on CPU)."""
+    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
 def register(name: str, backend: str = "xla"):
     def deco(fn):
         with _LOCK:
