@@ -9,3 +9,7 @@ from deeplearning4j_tpu.nlp.sequence_vectors import (
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.vectorizers import (
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)

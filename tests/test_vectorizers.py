@@ -1,0 +1,107 @@
+"""BagOfWords / TF-IDF vectorizer tests (bagofwords/vectorizer/ parity —
+VERDICT r3 missing #1). Known-value assertions pin the reference formulas
+tf = count/docLen, idf = log10(totalDocs/docFreq), weight = tf*idf
+(TfidfVectorizer.java:105,128; MathUtils.java:258,271,283)."""
+
+import math
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.vectorizers import (BagOfWordsVectorizer,
+                                                TfidfVectorizer)
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats and dogs",
+]
+
+
+class TestBagOfWords:
+    def test_doc_counts(self):
+        v = BagOfWordsVectorizer()
+        m = v.fit_transform(CORPUS)
+        assert m.shape == (3, len(v.vocab))
+        the = v.vocab.index_of("the")
+        cat = v.vocab.index_of("cat")
+        assert m[0, the] == 2.0 and m[1, the] == 2.0 and m[2, the] == 0.0
+        assert m[0, cat] == 1.0
+        # "the" is the most frequent word -> index 0 (frequency ordering)
+        assert the == 0
+
+    def test_reference_corpus_frequency_mode(self):
+        # BagOfWordsVectorizer.java:81 writes the CORPUS-wide frequency at
+        # each present column
+        v = BagOfWordsVectorizer(corpus_frequency=True)
+        m = v.fit_transform(CORPUS)
+        the = v.vocab.index_of("the")
+        assert m[0, the] == 4.0  # "the" occurs 4x in the corpus
+        assert m[2, the] == 0.0  # absent from doc 3
+
+    def test_min_frequency_and_stopwords(self):
+        v = BagOfWordsVectorizer(min_word_frequency=2,
+                                 stop_words=["the", "on"])
+        v.fit(CORPUS)
+        assert "the" not in v.vocab and "on" not in v.vocab
+        assert "sat" in v.vocab          # occurs twice
+        assert "cat" not in v.vocab      # occurs once < 2
+        row = v.transform("sat sat unknown")
+        assert row[v.vocab.index_of("sat")] == 2.0
+        assert row.sum() == 2.0          # unknown words contribute nothing
+
+
+class TestTfidf:
+    def test_known_values(self):
+        v = TfidfVectorizer()
+        m = v.fit_transform(CORPUS)
+        # "cat": doc 0 has 1 of 6 tokens; df("cat") = 1 of 3 docs
+        expect_cat = (1 / 6) * math.log10(3 / 1)
+        np.testing.assert_allclose(m[0, v.vocab.index_of("cat")],
+                                   expect_cat, rtol=1e-6)
+        # "sat": in docs 0,1 -> idf = log10(3/2)
+        expect_sat = (1 / 6) * math.log10(3 / 2)
+        np.testing.assert_allclose(m[0, v.vocab.index_of("sat")],
+                                   expect_sat, rtol=1e-6)
+        # a word appearing in every document would get idf log10(3/3)=0;
+        # "the" appears in 2 docs here
+        np.testing.assert_allclose(m[0, 0],
+                                   (2 / 6) * math.log10(3 / 2), rtol=1e-6)
+        # absent word -> 0
+        assert m[2, v.vocab.index_of("mat")] == 0.0
+
+    def test_transform_unseen_document(self):
+        v = TfidfVectorizer()
+        v.fit(CORPUS)
+        row = v.transform("cat cat zebra")
+        # tf = 2/3 (zebra kept in doc length: it IS a token of the doc)
+        expect = (2 / 3) * math.log10(3 / 1)
+        np.testing.assert_allclose(row[v.vocab.index_of("cat")], expect,
+                                   rtol=1e-6)
+        assert row.sum() == row[v.vocab.index_of("cat")]  # zebra -> nothing
+
+    def test_idf_all_docs_is_zero(self):
+        v = TfidfVectorizer()
+        v.fit(["apple banana", "apple cherry", "apple date"])
+        assert v.idf("apple") == 0.0
+        row = v.transform("apple apple")
+        assert row[v.vocab.index_of("apple")] == 0.0
+
+    def test_vectorize_dataset_and_labels(self):
+        v = TfidfVectorizer()
+        v.fit(CORPUS, labels=["pets", "pets", "animals"])
+        assert v.labels_source.labels == ["pets", "animals"]
+        ds = v.vectorize("the cat", "animals")
+        assert ds.features.shape == (1, len(v.vocab))
+        np.testing.assert_array_equal(np.asarray(ds.labels), [[0.0, 1.0]])
+
+    def test_tokenizer_factory_seam(self):
+        # the vectorizer consumes the SAME TokenizerFactory pipeline the
+        # embedding trainers use (BaseTextVectorizer.java:45-47)
+        tf = DefaultTokenizerFactory().set_token_pre_processor(
+            CommonPreprocessor())
+        v = TfidfVectorizer(tokenizer_factory=tf)
+        v.fit(["The CAT, sat!", "a dog."])
+        assert "cat" in v.vocab and "the" in v.vocab
+        assert "CAT," not in v.vocab
