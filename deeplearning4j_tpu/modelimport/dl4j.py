@@ -30,10 +30,12 @@ being present or absent (it changed across point releases).
 configuration.json field names vary across the reference's releases
 (plain strings in 0.5/0.6, @class-wrapped activation/loss objects in
 0.7/0.8); the translator accepts both (RegressionTest{050,060,071}.java
-is the parity surface). Ground-truth zips from a live Java stack are not
-available in this environment, so tests pin the format against fixtures
-produced by this module's symmetric writer (write_dl4j_zip), which
-follows the Java write path above line by line.
+is the parity surface). The format is pinned two ways: a HAND-PACKED
+golden fixture derived byte-by-byte from the Java write path
+(tests/fixtures/build_dl4j_golden.py + dl4j_mlp_golden.zip,
+tests/test_dl4j_golden.py — importer must read it and the writer must
+reproduce its coefficients.bin byte-identically), plus symmetric
+round-trip tests through write_dl4j_zip for the wider layer zoo.
 """
 
 from __future__ import annotations
@@ -210,7 +212,17 @@ def translate_layer(kind: str, ld: dict):
     n_in = None if n_in is None else int(n_in)
     n_out = None if n_out is None else int(n_out)
 
+    def _require(**named):
+        # fail loudly on a malformed layer dict: slicing with a None
+        # bound would silently produce wrong-length parameter views
+        missing = [k for k, v in named.items() if v is None]
+        if missing:
+            raise ValueError(
+                f"DL4J-zip import: layer '{kind}' is missing required "
+                f"field(s) {missing} in configuration.json")
+
     if kind in ("dense", "denseLayer"):
+        _require(nIn=n_in, nOut=n_out)
         conf = Dense(n_in=n_in, n_out=n_out, activation=act)
 
         def load(seg, params, state):
@@ -220,6 +232,7 @@ def translate_layer(kind: str, ld: dict):
         return conf, load, n_in * n_out + n_out
 
     if kind in ("output", "outputLayer"):
+        _require(nIn=n_in, nOut=n_out)
         conf = Output(n_in=n_in, n_out=n_out, activation=act,
                       loss=_loss_name(ld))
 
@@ -230,6 +243,7 @@ def translate_layer(kind: str, ld: dict):
         return conf, load, n_in * n_out + n_out
 
     if kind in ("rnnoutput", "rnnOutputLayer", "rnnOutput"):
+        _require(nIn=n_in, nOut=n_out)
         conf = RnnOutput(n_in=n_in, n_out=n_out, activation=act,
                          loss=_loss_name(ld))
 
@@ -240,6 +254,7 @@ def translate_layer(kind: str, ld: dict):
         return conf, load, n_in * n_out + n_out
 
     if kind in ("convolution", "convolutionLayer", "convolution2D"):
+        _require(nIn=n_in, nOut=n_out)
         kh, kw = _pair(_first(ld, "kernelSize", "kernel"), (5, 5))
         sh, sw = _pair(_first(ld, "stride"), (1, 1))
         ph, pw = _pair(_first(ld, "padding"), (0, 0))
@@ -266,6 +281,11 @@ def translate_layer(kind: str, ld: dict):
         return conf, None, 0
 
     if kind in ("batchNormalization", "batchNorm"):
+        if n_out is None and n_in is None:
+            raise ValueError(
+                "DL4J-zip import: batchNormalization layer carries neither "
+                "nIn nor nOut in configuration.json — cannot size "
+                "gamma/beta/mean/var")
         f = n_out if n_out else n_in
         conf = BatchNorm(eps=float(_first(ld, "eps", default=1e-5)),
                          decay=float(_first(ld, "decay", default=0.9)),
@@ -279,6 +299,7 @@ def translate_layer(kind: str, ld: dict):
         return conf, load, 4 * f
 
     if kind in ("gravesLSTM", "graveslstm", "gravesLstm"):
+        _require(nIn=n_in, nOut=n_out)
         gate_act = _first(ld, "gateActivationFn", "gateActivationFunction")
         gate = "sigmoid"
         if gate_act is not None:
