@@ -69,7 +69,7 @@ def conv1x1_bn_add_relu_xla(x, W, gamma, beta, shortcut, *, shift, eps,
 
 
 # --------------------------------------------------------------- pallas
-from deeplearning4j_tpu.ops.registry import pallas_interpret as _interpret
+_interpret = registry.pallas_interpret
 
 # VMEM budget for one grid step of the heaviest pass (backward apply):
 # the resident full [K, N] f32 dW accumulator + double-buffered tiles +
@@ -97,11 +97,15 @@ def _pick_tm(M, dtype, K=64, N=128):
     return None
 
 
-def pallas_supported(x, W):
+def pallas_supported(x, W, shortcut=None):
     if x.dtype not in (jnp.bfloat16, jnp.float32):
         return False
     K, N = W.shape[-2], W.shape[-1]
     if K % 64 != 0 or N % 128 != 0:
+        return False
+    if shortcut is not None and shortcut.shape != x.shape[:-1] + (N,):
+        # the xla backend broadcasts; the kernel needs a full-shape
+        # shortcut — fall back rather than mis-tile
         return False
     M = 1
     for d in x.shape[:-1]:
@@ -394,7 +398,7 @@ def conv1x1_bn_add_relu_pallas(x, W, gamma, beta, shortcut, *, shift, eps,
     delegates to the composed xla backend for configurations the kernel
     does not cover — the same graceful fallback the reference's helper
     loading performs when cuDNN is absent (ConvolutionLayer.java:69-76)."""
-    if not pallas_supported(x, W):
+    if not pallas_supported(x, W, shortcut):
         return conv1x1_bn_add_relu_xla(x, W, gamma, beta, shortcut,
                                        shift=shift, eps=eps, relu=relu)
     K = x.shape[-1]

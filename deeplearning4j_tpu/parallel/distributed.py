@@ -147,27 +147,48 @@ class MultiProcessLocalSGD:
             self.average_now()
         return score
 
-    def fit(self, iterator, *, epochs: int = 1):
+    def fit(self, iterator, *, epochs: int = 1, window: int | None = None):
         """Epoch loop over a LOCAL iterator. Processes may hold uneven
-        batch counts (dataset not divisible by process count): each epoch
-        the common step count is agreed via one allgather and the extra
-        local batches are dropped, so every process performs the same
-        number of collectives (no deadlock)."""
+        batch counts (dataset not divisible by process count), and the
+        agreed step count drives a COLLECTIVE schedule — so the counts
+        must reflect what iteration actually yields (a sized iterator
+        whose __len__ over-reports would deadlock the averaging allgather
+        on one host).
+
+        The agreement is WINDOWED: each round every process pulls up to
+        ``window`` batches into a bounded buffer, the available counts are
+        allgathered, the global minimum is trained on everywhere, and the
+        leftovers carry into the next round. Memory is bounded by
+        ``window`` batches (streaming epoch-scale data works), and the
+        total step count per epoch equals the global-minimum batch count —
+        identical to whole-epoch agreement. ``window`` defaults to
+        max(averaging_frequency, 16)."""
         from jax.experimental import multihost_utils
+        if window is None:
+            window = max(self.averaging_frequency, 16)
+        if window < 1:
+            raise ValueError("window must be >= 1")
         for _ in range(epochs):
-            # materialize the local epoch: the agreed step count drives a
-            # COLLECTIVE schedule, so it must reflect what iteration
-            # actually yields — a sized iterator whose __len__ over-reports
-            # would deadlock the averaging allgather on one host. The
-            # memory cost is the price of collective-count safety here;
-            # use fit_batch directly with an externally agreed schedule
-            # for streaming-scale data.
-            batches = list(iterator)
-            counts = multihost_utils.process_allgather(
-                np.asarray(len(batches)))
-            n = int(np.min(counts))
-            for ds in batches[:n]:
-                self.fit_batch(ds)
+            it = iter(iterator)
+            pending: list = []
+            exhausted = False
+            while True:
+                while len(pending) < window and not exhausted:
+                    try:
+                        pending.append(next(it))
+                    except StopIteration:
+                        exhausted = True
+                counts = multihost_utils.process_allgather(
+                    np.asarray(len(pending)))
+                n = int(np.min(counts))
+                if n == 0:
+                    # some process is out of data: epoch over everywhere
+                    # (its peers drop their surplus, as the reference's
+                    # balanced repartition would have prevented upstream)
+                    break
+                for ds in pending[:n]:
+                    self.fit_batch(ds)
+                pending = pending[n:]
             if hasattr(iterator, "reset"):
                 iterator.reset()
         if self._local_steps % self.averaging_frequency != 0:

@@ -57,7 +57,6 @@ def sequence_parallel_lstm(mesh: Mesh, seq_axis: str, params, x, h0, c0,
     training chunks via tBPTT instead).
     """
     from deeplearning4j_tpu.ops import registry as ops
-    from jax.experimental.shard_map import shard_map
 
     n = params["Wh"].shape[0]
     d = mesh.shape[seq_axis]
@@ -116,9 +115,9 @@ def sequence_parallel_lstm(mesh: Mesh, seq_axis: str, params, x, h0, c0,
         cT = jax.lax.psum(c_fin * is_last, seq_axis)
         return y_local, hT, cT
 
-    fn = shard_map(
+    fn = jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(None, seq_axis, None), P(), P()),
         out_specs=(P(None, seq_axis, None), P(), P()),
-        check_rep=False)
+        check_vma=False)
     return fn(params, x, h0, c0)

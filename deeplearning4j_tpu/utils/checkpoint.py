@@ -77,6 +77,13 @@ def save_checkpoint(net, path: str):
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, os.path.join(path, "meta.json"))
+    if jax.process_count() > 1:
+        # cross-process barrier AFTER the meta.json rename: without it a
+        # non-zero process returns as soon as its own shard writes land
+        # and can race a restore/guess_format against process 0 still
+        # finalizing — save_checkpoint must mean "complete everywhere"
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dl4j_tpu_ckpt_save_done")
     return path
 
 

@@ -64,6 +64,20 @@ def main():
                                                    averaging_frequency=2)
         for _ in range(steps):
             trainer.fit_batch(ds)
+    elif mode == "localsgd_fit":
+        # windowed-agreement fit over UNEVEN local iterators: process 0
+        # holds 5 batches, process 1 holds 7 — fit must train exactly
+        # min(5, 7) steps on every process without deadlock, pulling at
+        # most `window` batches into memory at a time
+        xg, yg = global_data(n=128)
+        n_local = 5 + 2 * pid
+        batches = [DataSet(xg[(pid * 16 + i) * 4:(pid * 16 + i + 1) * 4],
+                           yg[(pid * 16 + i) * 4:(pid * 16 + i + 1) * 4])
+                   for i in range(n_local)]
+        trainer = distributed.MultiProcessLocalSGD(net,
+                                                   averaging_frequency=2)
+        trainer.fit(batches, window=2)
+        assert trainer._local_steps == 5, trainer._local_steps
     else:
         mesh = make_mesh({"data": len(jax.devices())})
         net.use_mesh(mesh)

@@ -122,3 +122,20 @@ class TestFusedBlockEquivalence:
     def test_registered(self):
         assert "pallas" in ops.backends("conv1x1_bn_add_relu")
         assert "xla" in ops.backends("conv1x1_bn_add_relu")
+
+    def test_broadcast_shortcut_falls_back(self, interpret_mode):
+        # the xla backend broadcasts a (1, N) / (N,) shortcut; the kernel
+        # needs full shape — pallas must fall back, not mis-tile
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(128, 64)), jnp.float32)
+        W = jnp.asarray(rng.normal(size=(64, 128)) / 8.0, jnp.float32)
+        gamma = jnp.ones(128)
+        beta = jnp.zeros(128)
+        shift = jnp.zeros(128)
+        for sc in (jnp.zeros((1, 128)), jnp.zeros((128,))):
+            assert not fused_block.pallas_supported(x, W, sc)
+            y, _, _ = fused_block.conv1x1_bn_add_relu_pallas(
+                x, W, gamma, beta, sc, shift=shift, eps=1e-5)
+            y_x, _, _ = fused_block.conv1x1_bn_add_relu_xla(
+                x, W, gamma, beta, sc, shift=shift, eps=1e-5)
+            np.testing.assert_allclose(y, y_x, rtol=2e-5, atol=2e-5)
