@@ -9,3 +9,10 @@ from deeplearning4j_tpu.datasets.iterator import (
     AsyncDataSetIterator,
     MultipleEpochsIterator,
 )
+from deeplearning4j_tpu.datasets.fetchers import (
+    CifarDataSetIterator,
+    CurvesDataSetIterator,
+    IrisDataSetIterator,
+    LFWDataSetIterator,
+    MnistDataSetIterator,
+)

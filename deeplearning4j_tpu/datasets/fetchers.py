@@ -243,3 +243,128 @@ class IrisDataSetIterator(ArrayDataSetIterator):
         ds, self.descriptor = IrisDataFetcher().fetch(path=path, seed=seed)
         super().__init__(ds.features[:num_examples], ds.labels[:num_examples],
                          batch_size=batch_size, shuffle=False, seed=seed)
+
+class LFWDataFetcher:
+    """Labeled Faces in the Wild (LFWDataSetIterator.java /
+    datasets/fetchers/LFWDataFetcher.java parity). Reads the standard
+    extracted layout ``lfw/<person_name>/<person_name>_NNNN.jpg`` from a
+    ``path`` or the cache dirs; persons with fewer than
+    ``min_images_per_person`` images are dropped (the reference's
+    subset-by-label behavior). No-egress synthetic fallback: per-identity
+    face templates."""
+
+    def fetch(self, num_examples: Optional[int] = None,
+              image_size: Tuple[int, int] = (64, 64),
+              min_images_per_person: int = 2, num_labels: int = 10,
+              path: Optional[str] = None, seed: int = 0
+              ) -> Tuple[DataSet, DataSetDescriptor]:
+        h, w = image_size
+        root = path
+        if root is None:
+            for d in _search_dirs("lfw"):
+                if os.path.isdir(d):
+                    root = d
+                    break
+        if root and os.path.isdir(root):
+            people = []
+            for person in sorted(os.listdir(root)):
+                pdir = os.path.join(root, person)
+                if not os.path.isdir(pdir):
+                    continue
+                imgs = sorted(fn for fn in os.listdir(pdir)
+                              if fn.lower().endswith((".jpg", ".jpeg",
+                                                      ".png")))
+                if len(imgs) >= min_images_per_person:
+                    people.append((person, [os.path.join(pdir, fn)
+                                            for fn in imgs]))
+            # most-photographed first, capped at num_labels (the
+            # reference's useSubset semantics)
+            people.sort(key=lambda p: (-len(p[1]), p[0]))
+            people = people[:num_labels]
+            if people:
+                from PIL import Image
+                xs, ys = [], []
+                for label, (_, paths) in enumerate(people):
+                    for p in paths:
+                        img = Image.open(p).convert("RGB").resize((w, h))
+                        xs.append(np.asarray(img, np.float32) / 255.0)
+                        ys.append(label)
+                x = np.stack(xs)
+                y = np.eye(len(people), dtype=np.float32)[np.asarray(ys)]
+                if num_examples:
+                    x, y = x[:num_examples], y[:num_examples]
+                return (DataSet(x, y),
+                        DataSetDescriptor("lfw", False, len(x)))
+        n = num_examples or 400
+        x, y = _synthetic_images(num_labels, h, w, 3, n, seed)
+        return DataSet(x, y), DataSetDescriptor("lfw(synthetic)", True, n)
+
+
+def _render_curve(rng, size: int = 28) -> np.ndarray:
+    """Rasterize one random cubic Bezier stroke into a [size, size] float
+    image — the 'curves' dataset's generative family (the reference's
+    CurvesDataFetcher serves precomputed images of exactly such random
+    curves for the deep-autoencoder examples)."""
+    pts = rng.uniform(0.1, 0.9, (4, 2))
+    t = np.linspace(0.0, 1.0, 160)[:, None]
+    b = ((1 - t) ** 3 * pts[0] + 3 * (1 - t) ** 2 * t * pts[1]
+         + 3 * (1 - t) * t ** 2 * pts[2] + t ** 3 * pts[3])
+    img = np.zeros((size, size), np.float32)
+    ij = np.clip((b * size).astype(int), 0, size - 1)
+    img[ij[:, 1], ij[:, 0]] = 1.0
+    # 1-pixel blur to soften the stroke (matches the dataset's antialiased
+    # look and gives the autoencoder a non-binary target)
+    blurred = img.copy()
+    for dy, dx in ((0, 1), (0, -1), (1, 0), (-1, 0)):
+        blurred += 0.35 * np.roll(np.roll(img, dy, 0), dx, 1)
+    return np.clip(blurred, 0.0, 1.0)
+
+
+class CurvesDataFetcher:
+    """The 'curves' autoencoder dataset: 28x28 images of random cubic
+    curves (datasets/fetchers/CurvesDataFetcher.java parity — the
+    reference downloads precomputed curve images; here they load from a
+    cached ``curves.npz`` (key ``x``) or are generated deterministically,
+    which is faithful to the dataset's own synthetic construction).
+    Features == labels (autoencoder reconstruction target)."""
+
+    def fetch(self, num_examples: Optional[int] = None,
+              path: Optional[str] = None, seed: int = 0
+              ) -> Tuple[DataSet, DataSetDescriptor]:
+        p = path or _find_file("curves", ("curves.npz",))
+        if p and os.path.exists(p):
+            x = np.load(p)["x"].astype(np.float32)
+            if num_examples:
+                x = x[:num_examples]
+            x = x.reshape(len(x), -1)
+            return (DataSet(x, x.copy()),
+                    DataSetDescriptor("curves", False, len(x)))
+        n = num_examples or 2000
+        rng = np.random.default_rng(seed)
+        x = np.stack([_render_curve(rng) for _ in range(n)])
+        x = x.reshape(n, -1)
+        return (DataSet(x, x.copy()),
+                DataSetDescriptor("curves(synthetic)", True, n))
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 image_size: Tuple[int, int] = (64, 64),
+                 min_images_per_person: int = 2, num_labels: int = 10,
+                 shuffle: bool = True, seed: int = 123,
+                 path: Optional[str] = None):
+        ds, self.descriptor = LFWDataFetcher().fetch(
+            num_examples=num_examples, image_size=image_size,
+            min_images_per_person=min_images_per_person,
+            num_labels=num_labels, path=path, seed=seed)
+        super().__init__(ds.features, ds.labels, batch_size=batch_size,
+                         shuffle=shuffle, seed=seed)
+
+
+class CurvesDataSetIterator(ArrayDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 seed: int = 123, path: Optional[str] = None):
+        ds, self.descriptor = CurvesDataFetcher().fetch(
+            num_examples=num_examples, path=path, seed=seed)
+        super().__init__(ds.features, ds.labels, batch_size=batch_size,
+                         shuffle=False, seed=seed)

@@ -1,0 +1,197 @@
+"""Block-fusion pass: rewrite bottleneck-tail chains onto the fused op.
+
+The ComputationGraph executes vertices one by one (graph._walk), which
+leaves the conv -> batch-norm -> residual-add -> relu tail of every
+ResNet-style block to XLA's generic fusion: the conv output is
+materialized and re-read, and it is pinned as an autodiff residual. This
+pass pattern-matches those chains in the DAG *configuration* and routes
+them through ops/fused_block's ``conv1x1_bn_add_relu`` op (the
+two-pass-recompute schedule) at execution time — the framework-level
+analogue of the reference wiring whole-layer work into one cuDNN call
+(CudnnConvolutionHelper.java:49) instead of composing primitive ops.
+
+Pattern (all interior vertices single-consumer, none a network output):
+
+    conv: Convolution2D, 1x1 kernel, stride 1, no bias, identity
+          activation, no dropout, padding 0
+    bn:   BatchNorm, identity activation (params present)
+    add:  ElementWiseVertex(op="add") with exactly 2 inputs — the bn and
+          an arbitrary shortcut vertex
+    act:  ActivationLayer("relu")
+
+Profitability gate (measured on the v5e, PERF.md round 4): the recompute
+schedule reads x twice per pass, so it must satisfy 2*n_out > n_in AND
+n_in % 128 == 0 — C_in = 64 tensors are lane-padded to 128 on TPU, which
+doubles every x read and flips the trade (stage-1 bottlenecks stay on the
+composed path).
+
+The pass only changes the TRAINING step's lowering; eval-mode forward
+(running statistics, no batch stats) walks the graph unfused. OFF by
+default (see ``enabled``); opt in with DL4J_TPU_FUSE_BLOCKS=1.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict
+
+from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+from deeplearning4j_tpu.nn.conf.layers_conv import BatchNorm, Convolution2D
+from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex
+
+
+def enabled() -> bool:
+    """Default OFF: measured end-to-end on the v5e (PERF.md round 4), the
+    recompute schedule's cost-model savings on isolated chains did not
+    survive composition into the full ResNet-50 step (106.4 vs 103.7
+    ms/step, +2.7 GB) — XLA's own residual sharing beats the recompute
+    once the whole backward is in one program. The pass stays available
+    (DL4J_TPU_FUSE_BLOCKS=1) as the integration point for a future
+    schedule that does pay."""
+    return os.environ.get("DL4J_TPU_FUSE_BLOCKS", "0") == "1"
+
+
+@dataclass(frozen=True)
+class FusedBlockTail:
+    conv: str           # conv vertex name
+    bn: str             # batch-norm vertex name
+    add: str            # element-wise add vertex name
+    out: str            # relu activation vertex name (the chain's output)
+    conv_input: str     # vertex feeding the conv
+    shortcut: str       # the add's other input
+
+
+def _pair_of(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_matches(conf: Convolution2D, default_activation: str) -> bool:
+    if not isinstance(conf, Convolution2D):
+        return False
+    if _pair_of(conf.kernel) != (1, 1) or _pair_of(conf.stride) != (1, 1):
+        return False
+    if _pair_of(conf.dilation or 1) != (1, 1):
+        return False
+    if _pair_of(conf.padding or 0) != (0, 0):
+        return False
+    if conf.has_bias:
+        return False
+    # a None activation INHERITS the global default (sigmoid per the
+    # reference's NeuralNetConfiguration defaults) — resolve before
+    # matching, never assume identity
+    if (conf.activation or default_activation) != "identity":
+        return False
+    if getattr(conf, "dropout", None):
+        return False
+    n_in, n_out = conf.n_in, conf.n_out
+    if not n_in or not n_out:
+        return False
+    # profitability: expand conv, unpadded input lanes (see module doc)
+    return 2 * n_out > n_in and n_in % 128 == 0
+
+
+def find_fusable_chains(vertices, vertex_inputs, network_outputs,
+                        default_activation: str = "sigmoid"
+                        ) -> Dict[str, FusedBlockTail]:
+    """Scan a graph's RESOLVED vertex configs (n_in inference done) for
+    fusable block tails. Returns {relu-vertex-name: FusedBlockTail}."""
+    if not enabled():
+        return {}
+    consumers: Dict[str, list] = {}
+    for name, ins in vertex_inputs.items():
+        for i in ins:
+            consumers.setdefault(i, []).append(name)
+    outputs = set(network_outputs)
+
+    def sole_consumer(name):
+        c = consumers.get(name, [])
+        return c[0] if len(c) == 1 and name not in outputs else None
+
+    plans: Dict[str, FusedBlockTail] = {}
+    for conv_name, conv_conf in vertices.items():
+        if not _conv_matches(conv_conf, default_activation):
+            continue
+        bn_name = sole_consumer(conv_name)
+        if bn_name is None:
+            continue
+        bn_conf = vertices[bn_name]
+        if not isinstance(bn_conf, BatchNorm):
+            continue
+        if (bn_conf.activation or default_activation) != "identity":
+            continue
+        if getattr(bn_conf, "lock_gamma_beta", False):
+            continue
+        add_name = sole_consumer(bn_name)
+        if add_name is None:
+            continue
+        add_conf = vertices[add_name]
+        if not (isinstance(add_conf, ElementWiseVertex)
+                and add_conf.op == "add"):
+            continue
+        add_inputs = vertex_inputs[add_name]
+        if len(add_inputs) != 2 or bn_name not in add_inputs:
+            continue
+        shortcut = [i for i in add_inputs if i != bn_name]
+        if len(shortcut) != 1:   # bn feeding both slots: not this pattern
+            continue
+        act_name = sole_consumer(add_name)
+        if act_name is None:
+            continue
+        act_conf = vertices[act_name]
+        if not (isinstance(act_conf, ActivationLayer)
+                and (act_conf.activation
+                     or default_activation) == "relu"):
+            continue
+        plans[act_name] = FusedBlockTail(
+            conv=conv_name, bn=bn_name, add=add_name, out=act_name,
+            conv_input=vertex_inputs[conv_name][0],
+            shortcut=shortcut[0])
+    return plans
+
+
+def interior_vertices(plans: Dict[str, FusedBlockTail]) -> set:
+    """Vertices whose per-vertex execution is subsumed by a fused tail."""
+    out = set()
+    for fb in plans.values():
+        out.update((fb.conv, fb.bn, fb.add))
+    return out
+
+
+def execute_fused_tail(fb: FusedBlockTail, graph, params, state, acts):
+    """Run one fused tail (training mode): returns (y, bn_state_update).
+    Mirrors BatchNormLayer.apply's running-statistics update exactly."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import fused_block as _fb  # registers op
+    from deeplearning4j_tpu.ops import registry as ops
+
+    del _fb
+    conv_layer = graph._layer_by_name[fb.conv]
+    bn_layer = graph._layer_by_name[fb.bn]
+    bn_conf = graph._resolved_confs[fb.bn]
+    cd = conv_layer.compute_dtype
+
+    x = acts[fb.conv_input]
+    sc = acts[fb.shortcut]
+    W = params[fb.conv]["W"].astype(cd)          # [1, 1, K, N]
+    bn_params = params.get(fb.bn, {})
+    f = W.shape[-1]
+    if bn_params:
+        gamma, beta = bn_params["gamma"], bn_params["beta"]
+    else:
+        gamma = jnp.full((f,), float(bn_conf.gamma), bn_layer.param_dtype)
+        beta = jnp.full((f,), float(bn_conf.beta), bn_layer.param_dtype)
+    bn_state = state[fb.bn]
+
+    y, mean, var = ops.get("conv1x1_bn_add_relu", backend="xla_recompute")(
+        x.astype(cd), W, gamma, beta, sc, shift=bn_state["mean"],
+        eps=bn_conf.eps)
+
+    d = bn_conf.decay
+    sd = bn_layer.param_dtype
+    new_bn_state = {
+        "mean": d * bn_state["mean"] + (1 - d) * mean.astype(sd),
+        "var": d * bn_state["var"] + (1 - d) * var.astype(sd),
+    }
+    return y, new_bn_state

@@ -93,6 +93,15 @@ class ComputationGraph:
                 else:
                     input_types[name] = None
 
+        # block-fusion pass: pattern-match bottleneck tails on the RESOLVED
+        # configs (nn/fusion.py); applied in _walk for training walks only
+        from deeplearning4j_tpu.nn import fusion as _fusion
+        self._fusion_plans = _fusion.find_fusable_chains(
+            self._resolved_confs, self.conf.vertex_inputs,
+            self.conf.network_outputs,
+            default_activation=gc.activation or "sigmoid")
+        self._fusion_interior = _fusion.interior_vertices(self._fusion_plans)
+
         def init_trees(key):
             params, state = {}, {}
             for layer in self.layers:
@@ -190,7 +199,25 @@ class ComputationGraph:
         new_state = dict(state)
         from deeplearning4j_tpu.nn.conf.vertices import (
             DuplicateToTimeSeriesVertex, LastTimeStepVertex)
+        # training walks route matched bottleneck tails through the fused
+        # op (nn/fusion.py); eval walks use the per-vertex path (running
+        # statistics, no batch stats)
+        plans = getattr(self, "_fusion_plans", None) or {}
+        if not train:
+            plans = {}
+        interior = self._fusion_interior if plans else frozenset()
         for name in self.topo:
+            if name in interior:
+                continue
+            if name in plans:
+                from deeplearning4j_tpu.nn import fusion as _fusion
+                fb = plans[name]
+                y, bn_state_new = _fusion.execute_fused_tail(
+                    fb, self, params, state, acts)
+                acts[name] = y
+                masks[name] = None
+                new_state[fb.bn] = bn_state_new
+                continue
             conf = self._resolved_confs[name]
             in_names = self.conf.vertex_inputs[name]
             xs = [acts[i] for i in in_names]

@@ -411,3 +411,139 @@ def conv1x1_bn_add_relu_pallas(x, W, gamma, beta, shortcut, *, shift, eps,
                                  sc2, float(eps), bool(relu),
                                  jnp.asarray(shift, jnp.float32))
     return y.reshape(shortcut.shape), mean, var
+
+
+# ------------------------------------------------------- xla recompute
+# The schedule the Pallas kernel above implements, expressed as pure XLA:
+# measured on the axon TPU stack, Pallas DMA streams at 15-60 GB/s
+# against XLA's ~700 GB/s (see PERF.md round 4), so the SAME two-pass
+# recompute is lowered through XLA convs instead. Key facts this relies
+# on (verified via compiled cost analysis on the v5e):
+# - a conv whose output feeds ONLY sibling reductions fuses them into
+#   its epilogue WITHOUT materializing the conv output (the stats pass
+#   reads x and writes two [N] vectors — nothing else);
+# - elementwise chains do NOT output-fuse into convs on this XLA, so
+#   the composed formulation materializes z and re-reads it; the
+#   recompute apply pass pays one z materialization but the stats pass
+#   pays none, and z is never an autodiff residual;
+# - jax.lax.optimization_barrier on x blocks CSE from merging the stats
+#   conv with the apply conv (a merge would re-serialize the chain and
+#   restore the status-quo schedule).
+
+
+def _conv1x1(x, W):
+    """1x1 conv over the trailing channel axis as a convolution HLO (NOT a
+    dot: only the conv fuses sibling reductions into its epilogue on this
+    XLA). Accepts any leading shape; non-4D inputs ride through a [M,1,1,K]
+    view."""
+    K, N = W.shape[-2], W.shape[-1]
+    x4 = x if x.ndim == 4 else x.reshape(-1, 1, 1, K)
+    z = jax.lax.conv_general_dilated(
+        x4, W.reshape(1, 1, K, N), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return z if x.ndim == 4 else z.reshape(x.shape[:-1] + (N,))
+
+
+def _chan_stats(z, shift):
+    """Per-channel mean/var over all-but-last axes, f32 accumulation,
+    shifted single-pass variance (see ops/normalization._stats)."""
+    axes = tuple(range(z.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= z.shape[a]
+    k = jax.lax.stop_gradient(jnp.asarray(shift, jnp.float32))
+    zs = z.astype(jnp.float32) - k
+    s1 = jnp.sum(zs, axis=axes)
+    s2 = jnp.sum(zs * zs, axis=axes)
+    m1 = s1 / n
+    mean = m1 + k
+    var = jnp.maximum(s2 / n - m1 * m1, 0.0)
+    return mean, var, n
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def conv1x1_bn_add_relu_recompute(x, W, gamma, beta, shortcut, eps, relu,
+                                  shift):
+    y, mean, var, _, _ = _recompute_fwd_impl(x, W, gamma, beta, shortcut,
+                                             eps, relu, shift)
+    return y, mean, var
+
+
+def _recompute_fwd_impl(x, W, gamma, beta, shortcut, eps, relu, shift):
+    cd = x.dtype
+    # stats pass: conv consumed ONLY by the fused reductions
+    mean, var, _ = _chan_stats(_conv1x1(x, W), shift)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = jnp.asarray(gamma, jnp.float32) * inv
+    sh = jnp.asarray(beta, jnp.float32) - mean * scale
+    # apply pass: recompute the conv (barrier blocks CSE with the stats
+    # conv) and write the block output directly
+    z2 = _conv1x1(jax.lax.optimization_barrier(x), W)
+    o = z2 * scale.astype(cd) + sh.astype(cd) + shortcut.astype(cd)
+    if relu:
+        o = jnp.maximum(o, 0)
+    return o, mean, var, inv, scale
+
+
+def _recompute_fwd(x, W, gamma, beta, shortcut, eps, relu, shift):
+    y, mean, var, inv, scale = _recompute_fwd_impl(
+        x, W, gamma, beta, shortcut, eps, relu, shift)
+    return (y, mean, var), (x, W, gamma, mean, inv, scale, y)
+
+
+def _recompute_bwd(eps, relu, res, cts):
+    g = cts[0]  # stats outputs feed only the running update: zero cotangent
+    x, W, gamma, mean, inv, scale, y = res
+    cd = x.dtype
+    g = g.astype(cd)
+    if relu:
+        g = jnp.where(y > 0, g, jnp.zeros_like(g))
+    axes = tuple(range(g.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= g.shape[a]
+
+    meanc = mean.astype(cd)
+    invc = inv.astype(cd)
+
+    # reduction pass: recompute z, all reductions fuse into the conv
+    z1 = _conv1x1(jax.lax.optimization_barrier(x), W)
+    xhat1 = (z1 - meanc) * invc
+    a = jnp.sum(g.astype(jnp.float32), axis=axes)
+    b = jnp.sum((g * xhat1).astype(jnp.float32), axis=axes)
+
+    # dz pass: recompute z again (second barrier keeps it separate), form
+    # the BN input-cotangent in compute dtype (ops/normalization._bn_bwd
+    # arithmetic), then the two matmuls
+    z2 = _conv1x1(jax.lax.optimization_barrier(x), W)
+    xhat2 = (z2 - meanc) * invc
+    dz = scale.astype(cd) * (
+        g - (a / n).astype(cd) - xhat2 * (b / n).astype(cd))
+
+    K, N = W.shape[-2], W.shape[-1]
+    dx = _conv1x1(dz, jnp.swapaxes(W, -1, -2))
+    dW = jax.lax.dot_general(
+        x.reshape(-1, K), dz.reshape(-1, N),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dgamma = b.astype(gamma.dtype)
+    dbeta = a.astype(gamma.dtype)
+    return dx, dW.astype(W.dtype), dgamma, dbeta, g, None
+
+
+conv1x1_bn_add_relu_recompute.defvjp(_recompute_fwd, _recompute_bwd)
+
+
+@registry.register("conv1x1_bn_add_relu", backend="xla_recompute")
+def conv1x1_bn_add_relu_xla_recompute(x, W, gamma, beta, shortcut, *,
+                                      shift, eps, relu=True):
+    """Two-pass recompute schedule lowered through XLA (the backend the
+    block-fusion pass uses on TPU). Same signature/semantics as the
+    composed backend; equivalence-tested in tests/test_fused_block.py."""
+    W2 = W.reshape(W.shape[-2], W.shape[-1]).astype(x.dtype)
+    sc = jnp.broadcast_to(shortcut, x.shape[:-1] + (W2.shape[-1],))
+    y, mean, var = conv1x1_bn_add_relu_recompute(
+        x, W2, jnp.asarray(gamma, jnp.float32),
+        jnp.asarray(beta, jnp.float32), sc, float(eps), bool(relu),
+        jnp.asarray(shift, jnp.float32))
+    return y, mean, var

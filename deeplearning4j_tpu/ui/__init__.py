@@ -7,3 +7,4 @@ from deeplearning4j_tpu.ui.storage import (
     InMemoryStatsStorage,
 )
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.router import RemoteStatsStorageRouter

@@ -1,0 +1,82 @@
+"""Remote stats routing: N training processes -> one dashboard.
+
+Parity: the reference decouples stats producers from the UI via
+StatsStorageRouter (deeplearning4j-core api/storage/StatsStorageRouter
+.java) and ships a remote poster
+(deeplearning4j-ui-remote-iterationlisteners/.../RemoteFlowIterationListener
+.java:42) so workers on other machines feed one Play server's remote
+module. Here the router POSTs JSON reports to ui/server.py's
+``/api/post`` endpoint; it quacks like a StatsStorage, so it plugs
+straight into ``StatsListener(storage=RemoteStatsStorageRouter(url))`` —
+exactly how a DP-2 (multi-process, parallel/distributed.py) run gives
+every worker's curves to the process-0 dashboard.
+
+Delivery is best-effort with a bounded retry queue (the reference's
+remote listener is also fire-and-forget over HTTP): a dashboard restart
+drops nothing up to ``max_pending`` reports, and a dead dashboard never
+blocks the training loop.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Optional
+
+
+class RemoteStatsStorageRouter:
+    """POSTs StatsReports to a UIServer's /api/post endpoint."""
+
+    def __init__(self, url: str, timeout: float = 5.0,
+                 max_pending: int = 1000):
+        # accept ".../" or base host:port
+        self.url = url.rstrip("/") + "/api/post"
+        self.timeout = timeout
+        self._pending: deque = deque(maxlen=max_pending)
+        self.dropped = 0
+        self.posted = 0
+
+    # ------------------------------------------------- StatsStorage duck
+    def put_update(self, report) -> None:
+        self._enqueue({"type": "update", "report": report.to_dict()})
+
+    def put_static_info(self, session_id: str, worker_id: str,
+                        info: dict) -> None:
+        self._enqueue({"type": "static_info", "session_id": session_id,
+                       "worker_id": worker_id, "info": info})
+
+    # ---------------------------------------------------------- delivery
+    def _enqueue(self, payload: dict) -> None:
+        if len(self._pending) == self._pending.maxlen:
+            self.dropped += 1
+        self._pending.append(payload)
+        self.flush()
+
+    def flush(self) -> int:
+        """Attempt delivery of everything pending; returns #delivered.
+        Stops at the first failure (order-preserving)."""
+        delivered = 0
+        while self._pending:
+            payload = self._pending[0]
+            if not self._post(payload):
+                break
+            self._pending.popleft()
+            delivered += 1
+            self.posted += 1
+        return delivered
+
+    def _post(self, payload: dict) -> bool:
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return 200 <= r.status < 300
+        except (urllib.error.URLError, OSError):
+            return False
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)

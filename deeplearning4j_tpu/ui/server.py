@@ -60,35 +60,52 @@ select{margin-left:12px}
    <div id="model"></div></div>
 </div>
 <script>
-function line(svg, xs, ys, color){
+const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
+function lines(svg, seriesList){
+  // seriesList: [{xs, ys, color, label}] — one polyline per worker
   const el = document.getElementById(svg); el.innerHTML = "";
-  if (xs.length < 2) return;
+  const allx=[], ally=[];
+  seriesList.forEach(s=>{ s.xs.forEach((x,i)=>{
+    if(Number.isFinite(s.ys[i])){ allx.push(x); ally.push(s.ys[i]); }});});
+  if (allx.length < 2) return;
   const W = el.clientWidth || 480, H = el.clientHeight || 220, P = 30;
-  const xmin=Math.min(...xs), xmax=Math.max(...xs);
-  const finite = ys.filter(Number.isFinite);
-  if (!finite.length) return;
-  const ymin=Math.min(...finite), ymax=Math.max(...finite);
+  const xmin=Math.min(...allx), xmax=Math.max(...allx);
+  const ymin=Math.min(...ally), ymax=Math.max(...ally);
   const sx=x=>P+(W-2*P)*(x-xmin)/Math.max(xmax-xmin,1e-9);
   const sy=y=>H-P-(H-2*P)*(y-ymin)/Math.max(ymax-ymin,1e-9);
-  let d="";
-  xs.forEach((x,i)=>{ if(Number.isFinite(ys[i]))
-      d += (d?"L":"M")+sx(x).toFixed(1)+","+sy(ys[i]).toFixed(1); });
-  el.innerHTML =
+  let html =
    `<line x1="${P}" y1="${H-P}" x2="${W-P}" y2="${H-P}" stroke="#bbb"/>`+
    `<line x1="${P}" y1="${P}" x2="${P}" y2="${H-P}" stroke="#bbb"/>`+
    `<text x="${P}" y="${P-6}" font-size="10" fill="#888">`+
      `${ymax.toPrecision(4)}</text>`+
    `<text x="${P}" y="${H-P+12}" font-size="10" fill="#888">`+
-     `${ymin.toPrecision(4)}</text>`+
-   `<path d="${d}" fill="none" stroke="${color}" stroke-width="1.6"/>`;
+     `${ymin.toPrecision(4)}</text>`;
+  seriesList.forEach((s, k)=>{
+    let d="";
+    s.xs.forEach((x,i)=>{ if(Number.isFinite(s.ys[i]))
+        d += (d?"L":"M")+sx(x).toFixed(1)+","+sy(s.ys[i]).toFixed(1); });
+    html += `<path d="${d}" fill="none" stroke="${s.color}"`+
+            ` stroke-width="1.6"/>`;
+    if (s.label) html += `<text x="${W-P-70}" y="${P+12*(k+1)}"`+
+        ` font-size="10" fill="${s.color}">${s.label}</text>`;
+  });
+  el.innerHTML = html;
+}
+function workerSeries(u, field){
+  const ws = Object.keys(u.workers || {}).sort();
+  if (ws.length > 1)
+    return ws.map((w,k)=>({xs:u.workers[w].iterations,
+      ys:u.workers[w][field], color:COLORS[k%COLORS.length], label:w}));
+  return [{xs:u.iterations, ys:u[field==="scores"?"scores":"iteration_ms"],
+           color:COLORS[field==="scores"?0:1]}];
 }
 async function refresh(){
   const sess = document.getElementById("session").value;
   if (!sess) return;
   const u = await (await fetch("/api/updates?session="+
                    encodeURIComponent(sess))).json();
-  line("score", u.iterations, u.scores, "#1a73e8");
-  line("perf", u.iterations, u.iteration_ms, "#e8710a");
+  lines("score", workerSeries(u, "scores"));
+  lines("perf", workerSeries(u, "iteration_ms"));
   const last = u.latest;
   if (last) document.getElementById("latest").innerHTML =
     `<span class="stat">${Number(last.score).toPrecision(5)}</span>
@@ -160,6 +177,21 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._json({"error": "not found"}, 404)
 
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        # remote stats receiver (the reference UI's remote module:
+        # workers post through a StatsStorageRouter — ui/router.py)
+        ui: "UIServer" = self.server.ui_server  # type: ignore[attr-defined]
+        if urlparse(self.path).path != "/api/post":
+            self._json({"error": "not found"}, 404)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode())
+            ui.receive_post(payload)
+            self._json({"status": "ok"})
+        except Exception as e:  # malformed post must not kill the server
+            self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
 
 class UIServer:
     """Singleton dashboard server over attached StatsStorage instances."""
@@ -168,6 +200,7 @@ class UIServer:
 
     def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
         self.storages: List[BaseStatsStorage] = []
+        self._remote_storage: Optional[BaseStatsStorage] = None
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.ui_server = self  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]  # resolved if port=0
@@ -188,6 +221,25 @@ class UIServer:
     def attach(self, storage: BaseStatsStorage) -> None:
         if storage not in self.storages:
             self.storages.append(storage)
+
+    def receive_post(self, payload: dict) -> None:
+        """Store a remotely-posted report (lazily creating the receiving
+        storage on first post — the reference's remote-module role)."""
+        from deeplearning4j_tpu.ui.stats import StatsReport
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        if self._remote_storage is None:
+            self._remote_storage = InMemoryStatsStorage()
+            self.attach(self._remote_storage)
+        kind = payload.get("type")
+        if kind == "update":
+            self._remote_storage.put_update(
+                StatsReport.from_dict(payload["report"]))
+        elif kind == "static_info":
+            self._remote_storage.put_static_info(
+                payload["session_id"], payload["worker_id"],
+                payload["info"])
+        else:
+            raise ValueError(f"unknown post type {kind!r}")
 
     def detach(self, storage: BaseStatsStorage) -> None:
         self.storages = [s for s in self.storages if s is not storage]
@@ -226,11 +278,22 @@ class UIServer:
         if latest:
             latest.pop("param_stats", None)
             latest.pop("update_stats", None)
+        # per-worker series: a multi-process (DP-2) run posts through the
+        # remote router and every worker renders as its own curve
+        workers: dict = {}
+        for r in reports:
+            w = workers.setdefault(r.worker_id, {"iterations": [],
+                                                 "scores": [],
+                                                 "iteration_ms": []})
+            w["iterations"].append(r.iteration)
+            w["scores"].append(r.score)
+            w["iteration_ms"].append(r.iteration_ms)
         return {
             "iterations": [r.iteration for r in reports],
             "scores": [r.score for r in reports],
             "iteration_ms": [r.iteration_ms for r in reports],
             "examples_per_sec": [r.examples_per_sec for r in reports],
+            "workers": workers,
             "latest": latest,
         }
 

@@ -82,7 +82,12 @@ def _conv_bn(g, name: str, n_out: int, kernel, stride, inputs: str,
                               mode="same", has_bias=False,
                               activation="identity"),
                 inputs)
-    g.add_layer(f"{name}_bn", BatchNorm(), f"{name}_conv")
+    # activation must be EXPLICIT identity: a bare BatchNorm() inherits
+    # the global default activation (sigmoid, reference parity), which
+    # would squash every BN output — the round-1..3 zoo had exactly that
+    # bug, silently training (and benchmarking) a sigmoid-gated ResNet
+    g.add_layer(f"{name}_bn", BatchNorm(activation="identity"),
+                f"{name}_conv")
     if activation != "identity":
         g.add_layer(f"{name}_act", ActivationLayer(activation=activation),
                     f"{name}_bn")

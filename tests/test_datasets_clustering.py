@@ -250,3 +250,58 @@ class TestBarnesHutTsne:
                      np.linalg.norm(y[30:] - db, axis=1).mean())
         between = np.linalg.norm(da - db)
         assert between > 2 * within, (between, within)
+
+
+class TestLfwCurvesFetchers:
+    def test_lfw_from_directory_tree(self, tmp_path):
+        """Real-data path: standard lfw/<person>/<img>.jpg layout with
+        min-images filtering and most-photographed-first label subset."""
+        from PIL import Image
+
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        rng = np.random.default_rng(0)
+        counts = {"Alice_A": 4, "Bob_B": 3, "Carol_C": 1}  # Carol dropped
+        for person, n in counts.items():
+            d = tmp_path / person
+            d.mkdir()
+            for i in range(n):
+                arr = (rng.random((40, 30, 3)) * 255).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"{person}_{i:04d}.jpg")
+        it = LFWDataSetIterator(batch_size=4, image_size=(32, 32),
+                                min_images_per_person=2,
+                                path=str(tmp_path), shuffle=False)
+        assert not it.descriptor.synthetic
+        assert it.descriptor.num_examples == 7       # 4 + 3, Carol out
+        ds = next(iter(it))
+        assert np.asarray(ds.features).shape == (4, 32, 32, 3)
+        assert np.asarray(ds.labels).shape[1] == 2   # two identities
+        assert float(np.asarray(ds.features).max()) <= 1.0
+
+    def test_lfw_synthetic_fallback(self):
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        it = LFWDataSetIterator(batch_size=8, num_examples=24,
+                                image_size=(16, 16), num_labels=5,
+                                path="/nonexistent")
+        assert it.descriptor.synthetic
+        ds = next(iter(it))
+        assert np.asarray(ds.features).shape == (8, 16, 16, 3)
+
+    def test_curves_generation_and_cache(self, tmp_path):
+        from deeplearning4j_tpu.datasets import CurvesDataSetIterator
+        from deeplearning4j_tpu.datasets.fetchers import CurvesDataFetcher
+        it = CurvesDataSetIterator(batch_size=16, num_examples=64)
+        assert it.descriptor.synthetic
+        ds = next(iter(it))
+        x = np.asarray(ds.features)
+        assert x.shape == (16, 784)
+        # autoencoder contract: labels ARE the features
+        np.testing.assert_array_equal(x, np.asarray(ds.labels))
+        assert 0.0 < x.mean() < 0.5 and x.max() <= 1.0
+        # deterministic in seed
+        it2 = CurvesDataSetIterator(batch_size=16, num_examples=64)
+        np.testing.assert_array_equal(x, np.asarray(next(iter(it2)).features))
+        # cached-file path
+        np.savez(tmp_path / "curves.npz",
+                 x=np.random.default_rng(1).random((32, 28, 28)))
+        ds2, desc = CurvesDataFetcher().fetch(path=str(tmp_path / "curves.npz"))
+        assert not desc.synthetic and desc.num_examples == 32

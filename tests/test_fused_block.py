@@ -139,3 +139,57 @@ class TestFusedBlockEquivalence:
             y_x, _, _ = fused_block.conv1x1_bn_add_relu_xla(
                 x, W, gamma, beta, sc, shift=shift, eps=1e-5)
             np.testing.assert_allclose(y, y_x, rtol=2e-5, atol=2e-5)
+
+
+class TestRecomputeBackendEquivalence:
+    """The xla_recompute backend (the schedule the block-fusion pass uses
+    on TPU) must match the composed backend: forward, statistics, and all
+    five gradients."""
+
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_forward_and_grads(self, relu):
+        x, W, gamma, beta, sc, shift = _inputs(jnp.float32)
+        y_r, m_r, v_r = fused_block.conv1x1_bn_add_relu_xla_recompute(
+            x, W, gamma, beta, sc, shift=shift, eps=1e-5, relu=relu)
+        y_x, m_x, v_x = fused_block.conv1x1_bn_add_relu_xla(
+            x, W, gamma, beta, sc, shift=shift, eps=1e-5, relu=relu)
+        np.testing.assert_allclose(y_r, y_x, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(m_r, m_x, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(v_r, v_x, rtol=1e-4, atol=1e-5)
+
+        def loss(impl, x, W, gamma, beta, sc):
+            y, _, _ = impl(x, W, gamma, beta, sc, shift=shift, eps=1e-5,
+                           relu=relu)
+            return jnp.sum(y * jnp.cos(jnp.arange(y.size).reshape(y.shape)
+                                       * 0.01))
+
+        args = (x, W, gamma, beta, sc)
+        g_r = jax.grad(lambda *a: loss(
+            fused_block.conv1x1_bn_add_relu_xla_recompute, *a),
+            argnums=(0, 1, 2, 3, 4))(*args)
+        g_x = jax.grad(lambda *a: loss(
+            fused_block.conv1x1_bn_add_relu_xla, *a),
+            argnums=(0, 1, 2, 3, 4))(*args)
+        for name, a, b in zip(["dx", "dW", "dgamma", "dbeta", "dsc"],
+                              g_r, g_x):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=5e-4, atol=5e-5, err_msg=name)
+
+    def test_nhwc_and_broadcast_shortcut(self):
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(rng.normal(size=(2, 4, 4, 16)), jnp.float32)
+        W = jnp.asarray(rng.normal(size=(1, 1, 16, 32)) / 4.0, jnp.float32)
+        gamma = jnp.ones(32)
+        beta = jnp.zeros(32)
+        shift = jnp.zeros(32)
+        for sc in (jnp.asarray(rng.normal(size=(2, 4, 4, 32)), jnp.float32),
+                   jnp.zeros((32,), jnp.float32)):
+            y_r, _, _ = fused_block.conv1x1_bn_add_relu_xla_recompute(
+                x, W, gamma, beta, sc, shift=shift, eps=1e-5)
+            y_x, _, _ = fused_block.conv1x1_bn_add_relu_xla(
+                x, W, gamma, beta, sc, shift=shift, eps=1e-5)
+            np.testing.assert_allclose(y_r, y_x, rtol=2e-5, atol=2e-5)
+
+    def test_registered(self):
+        assert "xla_recompute" in ops.backends("conv1x1_bn_add_relu")

@@ -1,0 +1,139 @@
+"""Block-fusion pass tests (nn/fusion.py): pattern matching on the DAG,
+train-step equivalence fused vs unfused, eval-path invariance, and the
+profitability gate."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.nn import fusion
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import ActivationLayer, Output
+from deeplearning4j_tpu.nn.conf.layers_conv import (BatchNorm, Convolution2D,
+                                                    GlobalPooling)
+from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.updater import Sgd
+
+F32 = DtypePolicy(param_dtype="float32", compute_dtype="float32")
+
+
+@pytest.fixture(autouse=True)
+def fusion_on(monkeypatch):
+    # the pass is default-off (negative end-to-end perf result, PERF.md
+    # round 4); these tests exercise it explicitly
+    monkeypatch.setenv("DL4J_TPU_FUSE_BLOCKS", "1")
+
+
+def _mini_bottleneck(n_in=128, n_out=256):
+    """input -> proj(1x1) -> [conv1x1 -> bn -> add(shortcut) -> relu] ->
+    pool -> softmax; the bracketed tail matches the fusion pattern."""
+    g = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05))
+         .dtype(F32).graph_builder().add_inputs("img"))
+    g.add_layer("proj", Convolution2D(n_out=n_out, kernel=(1, 1),
+                                      has_bias=False,
+                                      activation="identity"), "img")
+    g.add_layer("c", Convolution2D(n_in=n_out, n_out=n_out, kernel=(1, 1),
+                                   has_bias=False, activation="identity"),
+                "proj")
+    g.add_layer("bn", BatchNorm(activation="identity"), "c")
+    g.add_vertex("add", ElementWiseVertex(op="add"), "bn", "proj")
+    g.add_layer("out_act", ActivationLayer(activation="relu"), "add")
+    g.add_layer("pool", GlobalPooling(pooling="avg"), "out_act")
+    g.add_layer("fc", Output(n_out=4, loss="mcxent", activation="softmax"),
+                "pool")
+    conf = (g.set_outputs("fc")
+            .set_input_types(InputType.convolutional(4, 4, n_in)).build())
+    return ComputationGraph(conf).init()
+
+
+def _data(n_in=128, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(b, 4, 4, n_in)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, b)]
+    return MultiDataSet([x], [y])
+
+
+class TestFusionPass:
+    def test_pattern_found(self):
+        net = _mini_bottleneck()
+        assert set(net._fusion_plans) == {"out_act"}
+        fb = net._fusion_plans["out_act"]
+        assert (fb.conv, fb.bn, fb.add) == ("c", "bn", "add")
+        assert fb.conv_input == "proj" and fb.shortcut == "proj"
+        assert net._fusion_interior == {"c", "bn", "add"}
+
+    def test_profitability_gate(self):
+        # n_in=64 fails the n_in % 128 gate -> no fusion
+        net = _mini_bottleneck(n_in=64, n_out=256)
+        # conv 'c' has n_in = 256 (proj out) -> still matches; rebuild the
+        # failing case directly: reduce conv 256 -> 64
+        c = Convolution2D(n_in=256, n_out=64, kernel=(1, 1), has_bias=False,
+                          activation="identity")
+        assert not fusion._conv_matches(c, "relu")     # 2*64 < 256
+        c2 = Convolution2D(n_in=64, n_out=256, kernel=(1, 1), has_bias=False,
+                           activation="identity")
+        assert not fusion._conv_matches(c2, "relu")    # 64 % 128 != 0
+        c3 = Convolution2D(n_in=128, n_out=256, kernel=(1, 1),
+                           has_bias=False, activation="identity")
+        assert fusion._conv_matches(c3, "relu")
+        # a None activation inherits the global default -> only matches
+        # when that default IS identity
+        c4 = Convolution2D(n_in=128, n_out=256, kernel=(1, 1),
+                           has_bias=False)
+        assert not fusion._conv_matches(c4, "sigmoid")
+        assert fusion._conv_matches(c4, "identity")
+
+    def test_train_equivalence_and_state(self, monkeypatch):
+        ds = _data()
+        net_f = _mini_bottleneck()
+        monkeypatch.setenv("DL4J_TPU_FUSE_BLOCKS", "0")
+        net_u = _mini_bottleneck()
+        assert net_u._fusion_plans == {}
+        monkeypatch.delenv("DL4J_TPU_FUSE_BLOCKS")
+
+        for _ in range(3):
+            s_f = net_f.fit_batch(ds)
+            s_u = net_u.fit_batch(ds)
+        np.testing.assert_allclose(float(net_f.score_value),
+                                   float(net_u.score_value),
+                                   rtol=1e-4, atol=1e-5)
+        for lname in net_f.params:
+            for pname in net_f.params[lname]:
+                np.testing.assert_allclose(
+                    np.asarray(net_f.params[lname][pname]),
+                    np.asarray(net_u.params[lname][pname]),
+                    rtol=2e-3, atol=2e-4, err_msg=f"{lname}.{pname}")
+        # BN running statistics advanced identically
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(net_f.state["bn"][k]),
+                np.asarray(net_u.state["bn"][k]),
+                rtol=1e-3, atol=1e-4, err_msg=k)
+
+    def test_eval_path_unfused_and_consistent(self):
+        ds = _data()
+        net = _mini_bottleneck()
+        net.fit_batch(ds)
+        # eval walks per-vertex with running stats; just assert it runs
+        # and is deterministic
+        out1 = np.asarray(net.output(ds.features[0]))
+        out2 = np.asarray(net.output(ds.features[0]))
+        np.testing.assert_array_equal(out1, out2)
+        ev = net.evaluate(ds)
+        assert 0.0 <= ev.accuracy() <= 1.0
+
+    def test_resnet50_finds_stage2plus_tails(self):
+        from deeplearning4j_tpu import zoo
+        net = zoo.resnet50(image_size=32)  # tiny image, same topology
+        plans = net._fusion_plans
+        # stage 1 (K=64) is gated out; stages 2-4 contribute 4 + 6 + 3
+        names = sorted(plans)
+        assert len(plans) == 13, names
+        assert not any(n.startswith("s0") for n in names)
+        for fb in plans.values():
+            assert fb.conv.endswith("_c_conv")
