@@ -122,6 +122,8 @@ def find_fusable_chains(vertices, vertex_inputs, network_outputs,
             continue
         if getattr(bn_conf, "lock_gamma_beta", False):
             continue
+        if getattr(bn_conf, "dropout", None):
+            continue  # fused tail has no dropout application point
         add_name = sole_consumer(bn_name)
         if add_name is None:
             continue
@@ -142,6 +144,8 @@ def find_fusable_chains(vertices, vertex_inputs, network_outputs,
         if not (isinstance(act_conf, ActivationLayer)
                 and (act_conf.activation
                      or default_activation) == "relu"):
+            continue
+        if getattr(act_conf, "dropout", None):
             continue
         plans[act_name] = FusedBlockTail(
             conv=conv_name, bn=bn_name, add=add_name, out=act_name,

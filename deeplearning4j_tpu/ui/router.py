@@ -20,6 +20,7 @@ blocks the training loop.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 from collections import deque
@@ -29,12 +30,14 @@ from typing import Optional
 class RemoteStatsStorageRouter:
     """POSTs StatsReports to a UIServer's /api/post endpoint."""
 
-    def __init__(self, url: str, timeout: float = 5.0,
-                 max_pending: int = 1000):
+    def __init__(self, url: str, timeout: float = 2.0,
+                 max_pending: int = 1000, retry_interval: float = 10.0):
         # accept ".../" or base host:port
         self.url = url.rstrip("/") + "/api/post"
         self.timeout = timeout
+        self.retry_interval = retry_interval
         self._pending: deque = deque(maxlen=max_pending)
+        self._last_failure: Optional[float] = None
         self.dropped = 0
         self.posted = 0
 
@@ -52,7 +55,14 @@ class RemoteStatsStorageRouter:
         if len(self._pending) == self._pending.maxlen:
             self.dropped += 1
         self._pending.append(payload)
-        self.flush()
+        # a black-holed dashboard must not stall every training
+        # iteration for the connect timeout: after a failure, buffer
+        # silently and only re-probe every retry_interval seconds
+        # (``flush()`` ignores the backoff for an explicit final drain)
+        if (self._last_failure is None
+                or time.monotonic() - self._last_failure
+                >= self.retry_interval):
+            self.flush()
 
     def flush(self) -> int:
         """Attempt delivery of everything pending; returns #delivered.
@@ -61,7 +71,9 @@ class RemoteStatsStorageRouter:
         while self._pending:
             payload = self._pending[0]
             if not self._post(payload):
+                self._last_failure = time.monotonic()
                 break
+            self._last_failure = None
             self._pending.popleft()
             delivered += 1
             self.posted += 1

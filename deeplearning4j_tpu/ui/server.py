@@ -61,6 +61,8 @@ select{margin-left:12px}
 </div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
+function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
+  .replace(/>/g,"&gt;").replace(/"/g,"&quot;"); }
 function lines(svg, seriesList){
   // seriesList: [{xs, ys, color, label}] — one polyline per worker
   const el = document.getElementById(svg); el.innerHTML = "";
@@ -87,7 +89,7 @@ function lines(svg, seriesList){
     html += `<path d="${d}" fill="none" stroke="${s.color}"`+
             ` stroke-width="1.6"/>`;
     if (s.label) html += `<text x="${W-P-70}" y="${P+12*(k+1)}"`+
-        ` font-size="10" fill="${s.color}">${s.label}</text>`;
+        ` font-size="10" fill="${s.color}">${esc(s.label)}</text>`;
   });
   el.innerHTML = html;
 }
@@ -126,7 +128,7 @@ async function refresh(){
     const up = (m.update_stats||{})[k] || {};
     const ratio = up.mean_magnitude && v.mean_magnitude ?
       (up.mean_magnitude/v.mean_magnitude).toExponential(2) : "—";
-    rows += `<tr><td>${k}</td><td>${v.mean_magnitude.toExponential(3)}</td>
+    rows += `<tr><td>${esc(k)}</td><td>${v.mean_magnitude.toExponential(3)}</td>
       <td>${up.mean_magnitude ? up.mean_magnitude.toExponential(3) : "—"}</td>
       <td>${ratio}</td></tr>`;
   }
@@ -135,7 +137,7 @@ async function refresh(){
 async function init(){
   const s = await (await fetch("/api/sessions")).json();
   const sel = document.getElementById("session");
-  sel.innerHTML = s.sessions.map(x=>`<option>${x}</option>`).join("");
+  sel.innerHTML = s.sessions.map(x=>`<option>${esc(x)}</option>`).join("");
   sel.onchange = refresh;
   await refresh();
   setInterval(refresh, 2000);
@@ -201,6 +203,7 @@ class UIServer:
     def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
         self.storages: List[BaseStatsStorage] = []
         self._remote_storage: Optional[BaseStatsStorage] = None
+        self._remote_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.ui_server = self  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]  # resolved if port=0
@@ -227,9 +230,13 @@ class UIServer:
         storage on first post — the reference's remote-module role)."""
         from deeplearning4j_tpu.ui.stats import StatsReport
         from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
-        if self._remote_storage is None:
-            self._remote_storage = InMemoryStatsStorage()
-            self.attach(self._remote_storage)
+        with self._remote_lock:
+            # handler threads race the first post: exactly ONE receiving
+            # storage may ever be attached or early reports strand in an
+            # orphan the dashboard resolves first
+            if self._remote_storage is None:
+                self._remote_storage = InMemoryStatsStorage()
+                self.attach(self._remote_storage)
         kind = payload.get("type")
         if kind == "update":
             self._remote_storage.put_update(
