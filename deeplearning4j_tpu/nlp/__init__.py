@@ -13,3 +13,4 @@ from deeplearning4j_tpu.nlp.vectorizers import (
     BagOfWordsVectorizer,
     TfidfVectorizer,
 )
+from deeplearning4j_tpu.nlp.distributed import MultiProcessSequenceVectors

@@ -1,0 +1,106 @@
+"""Multi-process embedding training — the Spark word2vec tier.
+
+Parity: dl4j-spark-nlp's map-side SkipGram
+(spark/dl4j-spark-nlp/.../word2vec/Word2VecPerformer.java:46 applies
+word2vec updates inside Spark partitions against driver-broadcast vocab
+and weights; FirstIterationFunction/SecondIterationFunction shard the
+corpus). TPU-native rendering: every process builds the IDENTICAL vocab +
+Huffman tree from the full corpus (deterministic construction replaces
+the driver broadcast), trains the batched device SkipGram/CBOW updates
+(nlp/sequence_vectors.py) on its strided corpus shard, and the embedding
+tables (syn0 / syn1 / syn1neg) are averaged across processes over DCN
+after every epoch — the LocalSGD schedule the DP tiers use
+(parallel/distributed.py), applied to the embedding "parameter server"
+state.
+
+Equivalence contract (statistical, not bitwise — the update ORDER differs
+from single-process by construction, exactly as the reference's Hogwild
+and Spark modes differ): tests/test_multihost.py asserts 2-process
+training leaves all processes bit-identical to EACH OTHER and preserves
+the corpus's similarity structure the way a single-process run does.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import jax
+import numpy as np
+
+
+def _average_across_processes(arr):
+    """Element-wise mean of one array across all processes (the
+    processResults aggregate/divide of ParameterAveragingTrainingMaster
+    .java:851-877, as one DCN allgather)."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(jax.device_get(arr)))
+    return jnp.asarray(np.mean(gathered, axis=0, dtype=np.float64).astype(
+        np.asarray(arr).dtype))
+
+
+class MultiProcessSequenceVectors:
+    """Wrap a SequenceVectors/Word2Vec/ParagraphVectors trainer for
+    multi-process corpus-sharded training."""
+
+    def __init__(self, vectors, shard: bool = True):
+        self.vectors = vectors
+        self.shard = shard
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    def _local_shard(self, sequences: List[List[str]]) -> List[List[str]]:
+        if not self.shard or self.process_count == 1:
+            return sequences
+        return sequences[self.process_index::self.process_count]
+
+    def average_now(self):
+        lt = self.vectors.lookup
+        lt.syn0 = _average_across_processes(lt.syn0)
+        if getattr(lt, "syn1", None) is not None:
+            lt.syn1 = _average_across_processes(lt.syn1)
+        if getattr(lt, "syn1neg", None) is not None:
+            lt.syn1neg = _average_across_processes(lt.syn1neg)
+        return self
+
+    def fit(self, sequences: Iterable[List[str]]):
+        """Vocab from the FULL corpus on every process (identical by
+        determinism), per-epoch training on the local shard, table
+        averaging after each epoch."""
+        sequences = list(sequences)
+        v = self.vectors
+        if v.vocab is None:
+            v.build_vocab(sequences)
+        local = self._local_shard(sequences)
+        epochs = v.config.epochs
+        lr0 = v.config.learning_rate
+        # drive the inner trainer one epoch at a time so the averaging
+        # schedule sits between epochs (Word2VecPerformer's per-iteration
+        # map/aggregate rounds collapse to this under LocalSGD semantics);
+        # each call is handed its WINDOW of the global linear lr schedule
+        # so annealing matches a single multi-epoch run
+        v.config.epochs = 1
+        try:
+            for e in range(epochs):
+                v.fit(local, lr_range=(lr0 * (1 - e / epochs),
+                                       lr0 * (1 - (e + 1) / epochs)))
+                if self.process_count > 1:
+                    self.average_now()
+        finally:
+            v.config.epochs = epochs
+        return self
+
+    # convenience delegates
+    def similarity(self, a: str, b: str) -> float:
+        return self.vectors.similarity(a, b)
+
+    def get_word_vector(self, word: str):
+        return self.vectors.get_word_vector(word)

@@ -122,9 +122,17 @@ class SequenceVectors:
                 ctx_rows.append((ctx, seq[pos]))
         return pairs_c, pairs_t, ctx_rows
 
-    def fit(self, sequences: Iterable[List[str]]):
+    def fit(self, sequences: Iterable[List[str]],
+            lr_range: Optional[tuple] = None):
         """Train (SequenceVectors.fit :187 parity). ``sequences`` may be any
-        re-iterable of token lists."""
+        re-iterable of token lists.
+
+        ``lr_range=(start, end)`` overrides the learning-rate window this
+        call sweeps linearly (floored at min_learning_rate). Default is
+        the full word2vec schedule (learning_rate -> 0). A multi-epoch
+        driver that calls fit once per epoch (nlp/distributed.py) passes
+        successive windows so the GLOBAL schedule matches a single
+        multi-epoch call."""
         cfg = self.config
         # Materialize one-shot iterators (iter(x) is x) so they survive the
         # two passes (vocab build + training); re-iterable streaming corpora
@@ -136,7 +144,9 @@ class SequenceVectors:
         seqs = self._sequences_to_indices(sequences)
         total_words = sum(len(s) for s in seqs) * cfg.epochs * cfg.iterations
         seen = 0
-        lr0 = cfg.learning_rate
+        lr_start, lr_end = (lr_range if lr_range is not None
+                            else (cfg.learning_rate, 0.0))
+        lr = max(cfg.min_learning_rate, lr_start)
 
         buf_c, buf_t, buf_ctx = [], [], []
         for _ in range(cfg.epochs):
@@ -152,8 +162,9 @@ class SequenceVectors:
                     buf_t.extend(pt)
                     buf_ctx.extend(ctx)
                     seen += len(seqs[si])
+                    frac = seen / max(total_words, 1)
                     lr = max(cfg.min_learning_rate,
-                             lr0 * (1.0 - seen / max(total_words, 1)))
+                             lr_start + (lr_end - lr_start) * frac)
                     while len(buf_c) >= cfg.batch_size:
                         self._apply_skipgram(buf_c[:cfg.batch_size],
                                              buf_t[:cfg.batch_size], lr)
@@ -161,10 +172,13 @@ class SequenceVectors:
                     while len(buf_ctx) >= cfg.batch_size:
                         self._apply_cbow(buf_ctx[:cfg.batch_size], lr)
                         del buf_ctx[:cfg.batch_size]
+        # tail flush at the schedule's CURRENT lr (for the default full
+        # schedule this is ~min_learning_rate, the old behavior; for a
+        # windowed call it must not collapse to the floor mid-training)
         if buf_c:
-            self._apply_skipgram(buf_c, buf_t, cfg.min_learning_rate)
+            self._apply_skipgram(buf_c, buf_t, lr)
         if buf_ctx:
-            self._apply_cbow(buf_ctx, cfg.min_learning_rate)
+            self._apply_cbow(buf_ctx, lr)
         return self
 
     # ------------------------------------------------------- batch applies

@@ -20,6 +20,7 @@ blocks the training loop.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -38,6 +39,8 @@ class RemoteStatsStorageRouter:
         self.retry_interval = retry_interval
         self._pending: deque = deque(maxlen=max_pending)
         self._last_failure: Optional[float] = None
+        self._flush_lock = threading.Lock()
+        self._retry_timer: Optional[threading.Timer] = None
         self.dropped = 0
         self.posted = 0
 
@@ -66,18 +69,33 @@ class RemoteStatsStorageRouter:
 
     def flush(self) -> int:
         """Attempt delivery of everything pending; returns #delivered.
-        Stops at the first failure (order-preserving)."""
-        delivered = 0
-        while self._pending:
-            payload = self._pending[0]
-            if not self._post(payload):
-                self._last_failure = time.monotonic()
-                break
-            self._last_failure = None
-            self._pending.popleft()
-            delivered += 1
-            self.posted += 1
-        return delivered
+        Stops at the first failure (order-preserving). A failure with
+        items still queued schedules a background retry so the queue's
+        TAIL is never stranded when training stops emitting (the daemon
+        timer dies with the process; an explicit final flush() remains
+        the reliable end-of-run drain)."""
+        with self._flush_lock:
+            delivered = 0
+            while self._pending:
+                payload = self._pending[0]
+                if not self._post(payload):
+                    self._last_failure = time.monotonic()
+                    self._schedule_retry()
+                    break
+                self._last_failure = None
+                self._pending.popleft()
+                delivered += 1
+                self.posted += 1
+            return delivered
+
+    def _schedule_retry(self) -> None:
+        # called under _flush_lock
+        if self._retry_timer is not None and self._retry_timer.is_alive():
+            return
+        t = threading.Timer(self.retry_interval, self.flush)
+        t.daemon = True
+        t.start()
+        self._retry_timer = t
 
     def _post(self, payload: dict) -> bool:
         req = urllib.request.Request(

@@ -39,6 +39,31 @@ def global_data(n=32):
     return x, y
 
 
+def w2v_corpus(n=240):
+    """Two-topic synthetic corpus: fruit words co-occur, vehicle words
+    co-occur — a trained model must place in-topic pairs closer than
+    cross-topic pairs."""
+    rng = np.random.default_rng(7)
+    topics = [["apple", "banana", "fruit", "juice", "sweet", "ripe"],
+              ["car", "road", "wheel", "engine", "drive", "fast"]]
+    corpus = []
+    for i in range(n):
+        # random topic per sentence (NOT alternating: a strided 2-process
+        # shard of an alternating corpus would give each process only ONE
+        # topic, which no averaging schedule can learn from)
+        pool = topics[rng.integers(0, 2)]
+        corpus.append([pool[j] for j in rng.integers(0, len(pool), 8)])
+    return corpus
+
+
+def build_w2v():
+    from deeplearning4j_tpu.nlp import Word2Vec
+    # hierarchical softmax: separates the two topics decisively on this
+    # tiny vocab (negative sampling is mushy at 12 words)
+    return Word2Vec(vector_size=24, window=3, epochs=8, negative=0,
+                    learning_rate=0.05, batch_size=256, seed=11)
+
+
 def main():
     coord, nproc, pid, out_path, steps = sys.argv[1:6]
     mode = sys.argv[6] if len(sys.argv) > 6 else "spmd"
@@ -78,6 +103,29 @@ def main():
                                                    averaging_frequency=2)
         trainer.fit(batches, window=2)
         assert trainer._local_steps == 5, trainer._local_steps
+    elif mode == "w2v":
+        # multi-process embedding training (Word2VecPerformer.java:46
+        # analogue): full-corpus vocab, strided shard, per-epoch averaging
+        from deeplearning4j_tpu.nlp import MultiProcessSequenceVectors
+        w2v = build_w2v()
+        trainer = MultiProcessSequenceVectors(w2v)
+        assert trainer.process_count == nproc
+        trainer.fit(w2v_corpus())
+        in_sync = distributed.sync_check(
+            {"syn0": w2v.lookup.syn0, "syn1": w2v.lookup.syn1})
+        sims = {
+            "in_a": w2v.similarity("apple", "banana"),
+            "in_b": w2v.similarity("car", "road"),
+            "cross": w2v.similarity("apple", "car"),
+        }
+        np.savez(out_path, __sync__=np.asarray(in_sync),
+                 __info__=np.asarray([jax.process_count(),
+                                      len(jax.devices())]),
+                 syn0=np.asarray(jax.device_get(w2v.lookup.syn0)),
+                 sims=np.asarray([sims["in_a"], sims["in_b"],
+                                  sims["cross"]]))
+        print("WORKER_OK", pid, in_sync, sims, flush=True)
+        return
     else:
         mesh = make_mesh({"data": len(jax.devices())})
         net.use_mesh(mesh)

@@ -241,3 +241,36 @@ print("POSTED", router.posted, "PENDING", router.pending)
         assert "remote_sess" in s["sessions"]
     finally:
         server.stop()
+
+
+def test_remote_router_background_retry_drains_tail():
+    """A dashboard that comes up AFTER the last report was enqueued must
+    still receive the queued tail (background retry timer) — the
+    enqueue-side backoff alone would strand it."""
+    import socket
+    import time as _time
+
+    from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+    from deeplearning4j_tpu.ui.stats import StatsReport
+
+    # reserve a port, keep it CLOSED for now
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    r = RemoteStatsStorageRouter(f"http://127.0.0.1:{port}", timeout=1.0,
+                                 retry_interval=0.5)
+    for i in range(3):
+        r.put_update(StatsReport("late_sess", "w", _time.time(), i, 0, 1.0))
+    assert r.pending == 3 and r.posted == 0
+    # dashboard comes up on that port AFTER the last enqueue
+    server = UIServer(port=port)
+    try:
+        deadline = _time.time() + 10
+        while r.pending and _time.time() < deadline:
+            _time.sleep(0.2)
+        assert r.pending == 0 and r.posted == 3, (r.pending, r.posted)
+        assert "late_sess" in server.sessions_payload()["sessions"]
+    finally:
+        server.stop()
