@@ -40,7 +40,7 @@ class RemoteStatsStorageRouter:
         self._pending: deque = deque(maxlen=max_pending)
         self._last_failure: Optional[float] = None
         self._flush_lock = threading.Lock()
-        self._retry_timer: Optional[threading.Timer] = None
+        self._retry_scheduled = False
         self.dropped = 0
         self.posted = 0
 
@@ -65,7 +65,10 @@ class RemoteStatsStorageRouter:
         if (self._last_failure is None
                 or time.monotonic() - self._last_failure
                 >= self.retry_interval):
-            self.flush()
+            # never let the training thread block behind a background
+            # retry that is mid-timeout on a dead host: if the lock is
+            # held, that retry (or its successor) will drain the queue
+            self._flush(blocking=False)
 
     def flush(self) -> int:
         """Attempt delivery of everything pending; returns #delivered.
@@ -74,7 +77,12 @@ class RemoteStatsStorageRouter:
         TAIL is never stranded when training stops emitting (the daemon
         timer dies with the process; an explicit final flush() remains
         the reliable end-of-run drain)."""
-        with self._flush_lock:
+        return self._flush(blocking=True)
+
+    def _flush(self, blocking: bool) -> int:
+        if not self._flush_lock.acquire(blocking=blocking):
+            return 0
+        try:
             delivered = 0
             while self._pending:
                 payload = self._pending[0]
@@ -87,15 +95,25 @@ class RemoteStatsStorageRouter:
                 delivered += 1
                 self.posted += 1
             return delivered
+        finally:
+            self._flush_lock.release()
 
     def _schedule_retry(self) -> None:
-        # called under _flush_lock
-        if self._retry_timer is not None and self._retry_timer.is_alive():
+        # called under _flush_lock; a plain flag (NOT Timer.is_alive —
+        # the currently-EXECUTING timer's thread is alive, which would
+        # suppress re-arming from within its own failed retry)
+        if self._retry_scheduled:
             return
-        t = threading.Timer(self.retry_interval, self.flush)
+        self._retry_scheduled = True
+
+        def fire():
+            with self._flush_lock:
+                self._retry_scheduled = False
+            self._flush(blocking=True)
+
+        t = threading.Timer(self.retry_interval, fire)
         t.daemon = True
         t.start()
-        self._retry_timer = t
 
     def _post(self, payload: dict) -> bool:
         req = urllib.request.Request(

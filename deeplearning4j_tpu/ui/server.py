@@ -59,6 +59,13 @@ select{margin-left:12px}
  <div class="card"><h3>Parameter mean magnitudes (latest)</h3>
    <div id="model"></div></div>
 </div>
+<div class="row">
+ <div class="card"><h3>Parameter histogram
+   <select id="histparam"></select>
+   <select id="histkind"><option value="param">weights</option>
+     <option value="update">updates</option></select></h3>
+   <svg id="hist"></svg></div>
+</div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -133,7 +140,47 @@ async function refresh(){
       <td>${ratio}</td></tr>`;
   }
   document.getElementById("model").innerHTML = rows + "</table>";
+  renderHistogram(m);
 }
+let lastModel = null;
+function renderHistogram(m){
+  if (m) lastModel = m; else m = lastModel;
+  if (!m) return;
+  const psel = document.getElementById("histparam");
+  const names = Object.keys(m.param_stats || {});
+  const current = Array.from(psel.options).map(o=>o.value);
+  if (JSON.stringify(current) !== JSON.stringify(names)){
+    const cur = psel.value;
+    psel.innerHTML = names.map(n=>`<option>${esc(n)}</option>`).join("");
+    if (names.includes(cur)) psel.value = cur;
+  }
+  const kind = document.getElementById("histkind").value;
+  const stats = kind === "update" ? (m.update_stats||{}) : m.param_stats;
+  const st = stats[psel.value];
+  const el = document.getElementById("hist"); el.innerHTML = "";
+  if (!st || !st.histogram) return;
+  const h = st.histogram, counts = h.counts;
+  const W = el.clientWidth || 480, H = el.clientHeight || 220, P = 30;
+  const cmax = Math.max(...counts, 1);
+  const bw = (W - 2*P) / counts.length;
+  let html = `<line x1="${P}" y1="${H-P}" x2="${W-P}" y2="${H-P}"`+
+             ` stroke="#bbb"/>`;
+  counts.forEach((c, i)=>{
+    const bh = (H - 2*P) * c / cmax;
+    html += `<rect x="${(P+i*bw).toFixed(1)}" y="${(H-P-bh).toFixed(1)}"`+
+      ` width="${Math.max(bw-1,1).toFixed(1)}" height="${bh.toFixed(1)}"`+
+      ` fill="#1a73e8"/>`;
+  });
+  html += `<text x="${P}" y="${H-P+12}" font-size="10" fill="#888">`+
+    `${Number(h.min).toPrecision(3)}</text>`+
+    `<text x="${W-P-40}" y="${H-P+12}" font-size="10" fill="#888">`+
+    `${Number(h.max).toPrecision(3)}</text>`+
+    `<text x="${P}" y="${P-6}" font-size="10" fill="#888">max bin `+
+    `${cmax}</text>`;
+  el.innerHTML = html;
+}
+document.getElementById("histparam").onchange = ()=>renderHistogram();
+document.getElementById("histkind").onchange = ()=>renderHistogram();
 async function init(){
   const s = await (await fetch("/api/sessions")).json();
   const sel = document.getElementById("session");

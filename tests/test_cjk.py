@@ -1,0 +1,102 @@
+"""CJK dictionary segmentation tests (nlp/cjk.py — the Kuromoji-shaped
+analyzer behind the TokenizerFactory seam, VERDICT r3 missing #6)."""
+
+import sys
+import types
+
+import pytest
+
+from deeplearning4j_tpu.nlp.cjk import (DictionarySegmenter,
+                                        DictionaryTokenizerFactory,
+                                        mecab_tokenizer_factory)
+from deeplearning4j_tpu.nlp.tokenization import LowCasePreprocessor
+from deeplearning4j_tpu.nlp.vectorizers import TfidfVectorizer
+
+
+class TestDictionarySegmenter:
+    def test_known_words_beat_char_soup(self):
+        seg = DictionarySegmenter()
+        # 私は猫が好き -> watashi|wa|neko|ga|suki (all in builtin lexicon)
+        assert seg.segment("私は猫が好き") == ["私", "は", "猫", "が", "好き"]
+        # multi-char dictionary words win over singles: 日本 / 東京 / 学校
+        assert seg.segment("日本の学校") == ["日本", "の", "学校"]
+
+    def test_unknown_runs_fall_back_to_chars(self):
+        seg = DictionarySegmenter(words=["東京"])
+        assert seg.segment("東京圏") == ["東京", "圏"]
+        assert seg.segment("圏域") == ["圏", "域"]
+
+    def test_longest_match_via_costs(self):
+        # both 電車 and 車 known: 電車で must prefer the longer word
+        seg = DictionarySegmenter()
+        assert "電車" in seg and "車" in seg
+        assert seg.segment("電車で行く") == ["電車", "で", "行く"]
+
+    def test_load_dictionary(self, tmp_path):
+        p = tmp_path / "lex.tsv"
+        p.write_text("深層学習\t1.0\n学習\n", encoding="utf-8")
+        seg = DictionarySegmenter(words=[]).load_dictionary(str(p))
+        # cheap 4-char entry beats 学習 + unknowns
+        assert seg.segment("深層学習") == ["深層学習"]
+
+    def test_empty(self):
+        assert DictionarySegmenter().segment("") == []
+
+
+class TestDictionaryTokenizerFactory:
+    def test_mixed_text_and_punctuation(self):
+        tf = DictionaryTokenizerFactory()
+        toks = tf.create("私は TPU で学習する。毎日！").get_tokens()
+        assert "私" in toks and "は" in toks and "TPU" in toks
+        assert "毎日" in toks
+        assert "。" not in toks and "！" not in toks
+
+    def test_preprocessor_applies(self):
+        tf = DictionaryTokenizerFactory()
+        tf.set_token_pre_processor(LowCasePreprocessor())
+        toks = tf.create("GPU と 猫").get_tokens()
+        assert "gpu" in toks and "猫" in toks
+
+    def test_plugs_into_vectorizer_seam(self):
+        # the point of the seam: the analyzer drops into any consumer of
+        # TokenizerFactory (here the tf-idf vectorizer)
+        v = TfidfVectorizer(tokenizer_factory=DictionaryTokenizerFactory())
+        v.fit(["私は猫が好き", "彼は犬が好き"])
+        assert "猫" in v.vocab and "犬" in v.vocab and "好き" in v.vocab
+        row = v.transform("猫が好き")
+        assert row[v.vocab.index_of("猫")] > 0
+
+    def test_word2vec_trains_on_segmented_corpus(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CollectionSentenceIterator)
+        sentences = ["私は猫が好き", "彼は猫が好き", "私は犬が好き",
+                     "彼女は犬が好き"] * 10
+        w2v = Word2Vec(vector_size=8, window=2, epochs=2, negative=0,
+                       min_word_frequency=2, seed=3)
+        w2v.fit_sentences(CollectionSentenceIterator(sentences),
+                          tokenizer_factory=DictionaryTokenizerFactory())
+        assert w2v.get_word_vector("猫") is not None
+        assert w2v.get_word_vector("好き") is not None
+
+
+class TestMecabWrapper:
+    def test_raises_without_binding(self):
+        with pytest.raises(ImportError, match="MeCab binding"):
+            mecab_tokenizer_factory()
+
+    def test_uses_fugashi_when_importable(self, monkeypatch):
+        # stub the optional dependency: proves the plug-in path end to end
+        class _Word:
+            def __init__(self, surface):
+                self.surface = surface
+
+        class _Tagger:
+            def __call__(self, text):
+                return [_Word(t) for t in text.split("|")]
+
+        stub = types.ModuleType("fugashi")
+        stub.Tagger = _Tagger
+        monkeypatch.setitem(sys.modules, "fugashi", stub)
+        tf = mecab_tokenizer_factory()
+        assert tf.create("猫|が|好き").get_tokens() == ["猫", "が", "好き"]
