@@ -41,20 +41,24 @@ def shard_sequence(mesh: Mesh, seq_axis: str, x, time_dim: int = 1):
 
 
 def sequence_parallel_lstm(mesh: Mesh, seq_axis: str, params, x, h0, c0,
-                           *, gate_act: str = "sigmoid",
+                           *, mask=None, gate_act: str = "sigmoid",
                            cell_act: str = "tanh"):
     """Graves-LSTM forward over a time-sharded sequence.
 
     ``params``: the GravesLSTM param dict {Wx, Wh, b, p} (replicated);
     ``x``: [b, T, f] with T sharded over ``seq_axis`` (see
-    ``shard_sequence``); ``h0``/``c0``: [b, n] replicated initial carry.
+    ``shard_sequence``); ``h0``/``c0``: [b, n] replicated initial carry;
+    ``mask``: optional [b, T] per-timestep mask, time-sharded like ``x``
+    — masked steps carry (h, c) through unchanged and emit zero output
+    (the reference-parity masking semantics, MaskedReductionUtil /
+    GravesLSTM masking), including across chunk boundaries: a chunk whose
+    steps are all masked hands its carry down the ring untouched.
     Returns (y [b, T, n] time-sharded, hT, cT replicated).
 
     Schedule: D wavefront steps; at step s the device holding chunk s
     runs its local cell scan (through the ``lstm_sequence`` registry op —
     the Pallas kernel on TPU), then the carry ppermutes one hop along the
-    ring. Masking is intentionally unsupported here (masked long-context
-    training chunks via tBPTT instead).
+    ring.
     """
     from deeplearning4j_tpu.ops import registry as ops
 
@@ -65,18 +69,21 @@ def sequence_parallel_lstm(mesh: Mesh, seq_axis: str, params, x, h0, c0,
             f"sequence length {x.shape[1]} is not divisible by the "
             f"'{seq_axis}' mesh axis ({d} devices) — pad the time axis")
     lstm_seq = ops.get("lstm_sequence")
+    has_mask = mask is not None
 
-    def local(params, x_local, h0, c0):
+    def local(params, x_local, h0, c0, m_local):
         idx = jax.lax.axis_index(seq_axis)
         cd = x_local.dtype
         p_cd = {k: v.astype(cd) for k, v in params.items()}
         # input projection: fully parallel over the local time chunk
         xz = jnp.einsum("btf,fg->btg", x_local, p_cd["Wx"]) + p_cd["b"]
         xz_t = jnp.moveaxis(xz, 1, 0)                     # [t_local, b, 4n]
+        m_t = (jnp.moveaxis(m_local.astype(cd), 1, 0)     # [t_local, b]
+               if has_mask else None)
 
         def turn(carry):
             h, c = carry
-            ys, hT, cT = lstm_seq(xz_t, h, c, p_cd["Wh"], p_cd["p"], None,
+            ys, hT, cT = lstm_seq(xz_t, h, c, p_cd["Wh"], p_cd["p"], m_t,
                                   gate_act=gate_act, cell_act=cell_act)
             return ys, (hT, cT)
 
@@ -115,9 +122,14 @@ def sequence_parallel_lstm(mesh: Mesh, seq_axis: str, params, x, h0, c0,
         cT = jax.lax.psum(c_fin * is_last, seq_axis)
         return y_local, hT, cT
 
+    if not has_mask:
+        # shard_map needs a concrete operand per spec — feed a scalar
+        # placeholder that the traced body never touches
+        mask = jnp.zeros((), x.dtype)
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(), P(None, seq_axis, None), P(), P()),
+        in_specs=(P(), P(None, seq_axis, None), P(), P(),
+                  P(None, seq_axis) if has_mask else P()),
         out_specs=(P(None, seq_axis, None), P(), P()),
         check_vma=False)
-    return fn(params, x, h0, c0)
+    return fn(params, x, h0, c0, mask)

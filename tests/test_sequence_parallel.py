@@ -85,3 +85,51 @@ def test_jit_compiles_the_whole_thing():
                                  jnp.asarray(jax.device_get(x)), h0, c0)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y),
                                rtol=1e-10, atol=1e-12)
+
+
+def test_sequence_parallel_masked_matches_single_device():
+    """Masked sequence parallelism (VERDICT r3 weak #6): per-timestep
+    masks sharded with the time axis must reproduce the single-device
+    masked LSTM exactly — including carry-through across chunk boundaries
+    when a whole device's chunk is masked."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import registry as ops
+
+    mesh8 = make_mesh({"seq": 8})
+    rng = np.random.default_rng(5)
+    b, T, f, n = 4, 16, 8, 8          # 8 devices x 2 steps each
+    params = {
+        "Wx": jnp.asarray(rng.normal(0, 0.4, (f, 4 * n)), jnp.float32),
+        "Wh": jnp.asarray(rng.normal(0, 0.4, (n, 4 * n)), jnp.float32),
+        "b": jnp.asarray(rng.normal(0, 0.1, (4 * n,)), jnp.float32),
+        "p": jnp.asarray(rng.normal(0, 0.1, (3, n)), jnp.float32),
+    }
+    x = jnp.asarray(rng.normal(size=(b, T, f)), jnp.float32)
+    # ragged lengths incl. one sequence short enough that entire device
+    # chunks (steps 8..15) are masked out
+    lengths = np.array([16, 11, 7, 3])
+    mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    h0 = jnp.zeros((b, n)); c0 = jnp.zeros((b, n))
+
+    # single-device reference through the same registry op
+    xz = jnp.einsum("btf,fg->btg", x, params["Wx"]) + params["b"]
+    ys_ref, hT_ref, cT_ref = ops.get("lstm_sequence")(
+        jnp.moveaxis(xz, 1, 0), h0, c0, params["Wh"], params["p"],
+        jnp.moveaxis(jnp.asarray(mask), 1, 0))
+    y_ref = jnp.moveaxis(ys_ref, 0, 1)
+
+    xs = shard_sequence(mesh8, "seq", x)
+    ms = shard_sequence(mesh8, "seq", jnp.asarray(mask))
+    y, hT, cT = sequence_parallel_lstm(mesh8, "seq", params, xs, h0, c0,
+                                       mask=ms)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(hT_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(cT_ref),
+                               rtol=1e-5, atol=1e-6)
+    # masked positions emit exactly zero
+    np.testing.assert_array_equal(
+        np.asarray(y)[2, 7:], np.zeros_like(np.asarray(y)[2, 7:]))
