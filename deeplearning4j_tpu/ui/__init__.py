@@ -8,3 +8,4 @@ from deeplearning4j_tpu.ui.storage import (
 )
 from deeplearning4j_tpu.ui.server import UIServer
 from deeplearning4j_tpu.ui.router import RemoteStatsStorageRouter
+from deeplearning4j_tpu.ui.embedding import publish_embedding

@@ -65,6 +65,9 @@ select{margin-left:12px}
    <select id="histkind"><option value="param">weights</option>
      <option value="update">updates</option></select></h3>
    <svg id="hist"></svg></div>
+ <div class="card" id="embcard" style="display:none">
+   <h3>Embedding map (t-SNE)</h3><svg id="emb" style="height:320px"></svg>
+ </div>
 </div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
@@ -141,6 +144,7 @@ async function refresh(){
   }
   document.getElementById("model").innerHTML = rows + "</table>";
   renderHistogram(m);
+  await refreshEmbedding(sess);
 }
 let lastModel = null;
 function renderHistogram(m){
@@ -181,6 +185,35 @@ function renderHistogram(m){
 }
 document.getElementById("histparam").onchange = ()=>renderHistogram();
 document.getElementById("histkind").onchange = ()=>renderHistogram();
+let embCache = {sess: null, found: false};
+async function refreshEmbedding(sess){
+  // a published embedding is STATIC: once rendered for this session,
+  // skip the fetch + SVG rebuild on every 2s poll
+  if (embCache.sess === sess && embCache.found) return;
+  const e = await (await fetch("/api/embedding?session="+
+                   encodeURIComponent(sess))).json();
+  const card = document.getElementById("embcard");
+  embCache = {sess: sess, found: !!(e.xy && e.xy.length)};
+  if (!embCache.found){ card.style.display = "none"; return; }
+  card.style.display = "";
+  const el = document.getElementById("emb"); el.innerHTML = "";
+  const W = el.clientWidth || 480, H = el.clientHeight || 320, P = 20;
+  const xs = e.xy.map(p=>p[0]), ys = e.xy.map(p=>p[1]);
+  const xmin=Math.min(...xs), xmax=Math.max(...xs);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const sx=x=>P+(W-2*P)*(x-xmin)/Math.max(xmax-xmin,1e-9);
+  const sy=y=>H-P-(H-2*P)*(y-ymin)/Math.max(ymax-ymin,1e-9);
+  let html = "";
+  e.xy.forEach((p, i)=>{
+    const c = COLORS[i % COLORS.length];
+    html += `<circle cx="${sx(p[0]).toFixed(1)}" cy="${sy(p[1]).toFixed(1)}"`+
+      ` r="2.5" fill="${c}"/>`;
+    if (e.labels[i]) html += `<text x="${(sx(p[0])+4).toFixed(1)}"`+
+      ` y="${(sy(p[1])+3).toFixed(1)}" font-size="9" fill="#555">`+
+      `${esc(e.labels[i])}</text>`;
+  });
+  el.innerHTML = html;
+}
 async function init(){
   const s = await (await fetch("/api/sessions")).json();
   const sel = document.getElementById("session");
@@ -223,6 +256,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.updates_payload(sess, after))
         elif url.path == "/api/model":
             self._json(ui.model_payload(q.get("session", "")))
+        elif url.path == "/api/embedding":
+            self._json(ui.embedding_payload(q.get("session", "")))
         else:
             self._json({"error": "not found"}, 404)
 
@@ -350,6 +385,13 @@ class UIServer:
             "workers": workers,
             "latest": latest,
         }
+
+    def embedding_payload(self, session_id: str) -> dict:
+        """Published 2-D embedding scatter for the session (the reference
+        UI's tsne tab — ui/embedding.py publishes it)."""
+        from deeplearning4j_tpu.ui.embedding import get_embedding
+        info = get_embedding(self.storages, session_id)
+        return info or {"labels": [], "xy": []}
 
     def model_payload(self, session_id: str) -> dict:
         storage = self._find(session_id)

@@ -1,0 +1,92 @@
+"""N training processes -> ONE live dashboard (remote stats routing).
+
+The reference's remote-UI story (workers post stats through a
+StatsStorageRouter to one Play server's remote module,
+RemoteFlowIterationListener.java:42) rendered TPU-native: this script
+starts the dashboard (ui.UIServer), spawns two worker processes that each
+train their own model with
+``StatsListener(storage=RemoteStatsStorageRouter(url))``, and leaves the
+dashboard up so you can watch both workers' score curves and parameter
+histograms side by side.
+
+Run: python examples/remote_dashboard.py
+(then open the printed URL; Ctrl-C to stop)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_WORKER = r"""
+import sys, os
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.ui import StatsListener, RemoteStatsStorageRouter
+
+worker_id, url = sys.argv[1], sys.argv[2]
+rng = np.random.default_rng(abs(hash(worker_id)) % 2**31)
+centers = rng.normal(0, 2.0, (5, 32))
+labels = rng.integers(0, 5, 2048)
+x = (centers[labels] + rng.normal(0, 1, (2048, 32))).astype(np.float32)
+y = np.eye(5, dtype=np.float32)[labels]
+conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3)).list()
+        .layer(Dense(n_in=32, n_out=64, activation="relu"))
+        .layer(Output(n_out=5, activation="softmax", loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+router = RemoteStatsStorageRouter(url)
+net.set_listeners(StatsListener(router, frequency=2,
+                                session_id="cluster_run",
+                                worker_id=worker_id))
+net.fit(ArrayDataSetIterator(x, y, batch_size=64), epochs=10)
+router.flush()
+print(worker_id, "done; posted", router.posted, flush=True)
+"""
+
+
+def main():
+    from deeplearning4j_tpu.ui import UIServer
+
+    server = UIServer.get_instance(port=int(os.environ.get("UI_PORT", 0)))
+    print("dashboard:", server.url, flush=True)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _WORKER.format(repo=repo)
+    procs = [subprocess.Popen([sys.executable, "-c", script,
+                               f"worker_{i}", server.url])
+             for i in range(2)]
+    for p in procs:
+        p.wait()
+
+    with urllib.request.urlopen(
+            server.url + "api/updates?session=cluster_run",
+            timeout=30) as r:
+        u = json.loads(r.read().decode())
+    for wid, series in sorted(u["workers"].items()):
+        print(f"{wid}: {len(series['iterations'])} updates, "
+              f"score {series['scores'][0]:.3f} -> {series['scores'][-1]:.3f}")
+
+    if os.environ.get("DL4J_TPU_EXAMPLE_NONINTERACTIVE"):
+        server.stop()
+        return
+    print("dashboard stays up — Ctrl-C to exit")
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -289,3 +289,55 @@ def test_dashboard_page_has_histogram_panel():
     for needle in ("histparam", "histkind", "renderHistogram",
                    "id=\"hist\""):
         assert needle in _PAGE, needle
+
+
+def test_embedding_tab_publish_and_fetch():
+    """The reference UI's tsne tab (ui/module/tsne): publish a labeled
+    2-D projection of word vectors, fetch it through /api/embedding —
+    locally attached AND posted through the remote router."""
+    import urllib.request
+
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage,
+                                       RemoteStatsStorageRouter, UIServer,
+                                       publish_embedding)
+
+    rng = np.random.default_rng(0)
+    # two well-separated clusters: the projection must keep them apart
+    vecs = np.concatenate([rng.normal(0, 0.2, (6, 16)),
+                           rng.normal(4, 0.2, (6, 16))])
+    labels = [f"a{i}" for i in range(6)] + [f"b{i}" for i in range(6)]
+
+    storage = InMemoryStatsStorage()
+    xy = publish_embedding(storage, "emb_sess", vecs, labels,
+                           iterations=400)
+    assert xy.shape == (12, 2)
+    intra = np.mean([np.linalg.norm(xy[i] - xy[j])
+                     for g in (range(6), range(6, 12))
+                     for i in g for j in g if i < j])
+    inter = np.mean([np.linalg.norm(xy[i] - xy[j])
+                     for i in range(6) for j in range(6, 12)])
+    assert inter > intra, (inter, intra)
+
+    server = UIServer(port=0)
+    try:
+        server.attach(storage)
+        with urllib.request.urlopen(
+                server.url + "api/embedding?session=emb_sess",
+                timeout=30) as r:
+            e = json.loads(r.read().decode())
+        assert e["labels"] == labels and len(e["xy"]) == 12
+        # remote path: a worker posts its embedding through the router
+        router = RemoteStatsStorageRouter(server.url)
+        publish_embedding(router, "remote_emb", vecs[:6], labels[:6],
+                          iterations=80)
+        with urllib.request.urlopen(
+                server.url + "api/embedding?session=remote_emb",
+                timeout=30) as r:
+            e2 = json.loads(r.read().decode())
+        assert e2["labels"] == labels[:6] and len(e2["xy"]) == 6
+        # page carries the tab
+        with urllib.request.urlopen(server.url, timeout=30) as r:
+            page = r.read().decode()
+        assert 'id="emb"' in page and "refreshEmbedding" in page
+    finally:
+        server.stop()
