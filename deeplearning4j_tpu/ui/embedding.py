@@ -43,9 +43,14 @@ def publish_embedding(storage, session_id: str, vectors,
         xy = np.asarray(Tsne(n_components=2, perplexity=perplexity,
                              max_iter=iterations,
                              seed=seed).fit_transform(x))
+    import time
     storage.put_static_info(session_id, EMBEDDING_KEY, {
         "labels": labels,
         "xy": [[float(a), float(b)] for a, b in xy],
+        # version stamp: the dashboard re-fetches/re-renders only when a
+        # NEW publish lands (re-published embeddings must not be served
+        # from the client cache forever)
+        "version": time.time(),
     })
     return xy
 

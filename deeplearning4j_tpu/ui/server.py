@@ -63,7 +63,8 @@ select{margin-left:12px}
  <div class="card"><h3>Parameter histogram
    <select id="histparam"></select>
    <select id="histkind"><option value="param">weights</option>
-     <option value="update">updates</option></select></h3>
+     <option value="update">updates</option>
+     <option value="activation">activations</option></select></h3>
    <svg id="hist"></svg></div>
  <div class="card" id="embcard" style="display:none">
    <h3>Embedding map (t-SNE)</h3><svg id="emb" style="height:320px"></svg>
@@ -144,22 +145,26 @@ async function refresh(){
   }
   document.getElementById("model").innerHTML = rows + "</table>";
   renderHistogram(m);
-  await refreshEmbedding(sess);
+  await refreshEmbedding(sess, m.embedding_version ?? null);
 }
 let lastModel = null;
 function renderHistogram(m){
   if (m) lastModel = m; else m = lastModel;
   if (!m) return;
   const psel = document.getElementById("histparam");
-  const names = Object.keys(m.param_stats || {});
+  const kind = document.getElementById("histkind").value;
+  // the selector lists the names of the CHOSEN kind (activation stats
+  // use layer names, parameter/update stats use parameter paths)
+  const stats = kind === "update" ? (m.update_stats||{}) :
+    kind === "activation" ? (m.activation_stats||{}) :
+    (m.param_stats||{});
+  const names = Object.keys(stats);
   const current = Array.from(psel.options).map(o=>o.value);
   if (JSON.stringify(current) !== JSON.stringify(names)){
     const cur = psel.value;
     psel.innerHTML = names.map(n=>`<option>${esc(n)}</option>`).join("");
     if (names.includes(cur)) psel.value = cur;
   }
-  const kind = document.getElementById("histkind").value;
-  const stats = kind === "update" ? (m.update_stats||{}) : m.param_stats;
   const st = stats[psel.value];
   const el = document.getElementById("hist"); el.innerHTML = "";
   if (!st || !st.histogram) return;
@@ -185,16 +190,17 @@ function renderHistogram(m){
 }
 document.getElementById("histparam").onchange = ()=>renderHistogram();
 document.getElementById("histkind").onchange = ()=>renderHistogram();
-let embCache = {sess: null, found: false};
-async function refreshEmbedding(sess){
-  // a published embedding is STATIC: once rendered for this session,
-  // skip the fetch + SVG rebuild on every 2s poll
-  if (embCache.sess === sess && embCache.found) return;
+let embCache = {sess: null, version: null};
+async function refreshEmbedding(sess, version){
+  // fetch + rebuild the scatter only when a (re)published embedding's
+  // version changes — /api/model carries the version on every poll
+  if (embCache.sess === sess && embCache.version === version) return;
+  embCache = {sess: sess, version: version};
+  const card = document.getElementById("embcard");
+  if (version == null){ card.style.display = "none"; return; }
   const e = await (await fetch("/api/embedding?session="+
                    encodeURIComponent(sess))).json();
-  const card = document.getElementById("embcard");
-  embCache = {sess: sess, found: !!(e.xy && e.xy.length)};
-  if (!embCache.found){ card.style.display = "none"; return; }
+  if (!e.xy || e.xy.length === 0){ card.style.display = "none"; return; }
   card.style.display = "";
   const el = document.getElementById("emb"); el.innerHTML = "";
   const W = el.clientWidth || 480, H = el.clientHeight || 320, P = 20;
@@ -367,6 +373,7 @@ class UIServer:
         if latest:
             latest.pop("param_stats", None)
             latest.pop("update_stats", None)
+            latest.pop("activation_stats", None)
         # per-worker series: a multi-process (DP-2) run posts through the
         # remote router and every worker renders as its own curve
         workers: dict = {}
@@ -396,7 +403,15 @@ class UIServer:
     def model_payload(self, session_id: str) -> dict:
         storage = self._find(session_id)
         latest = storage.get_latest_update(session_id) if storage else None
+        from deeplearning4j_tpu.ui.embedding import get_embedding
+        emb = get_embedding(self.storages, session_id) or {}
         if latest is None:
-            return {"param_stats": {}, "update_stats": {}}
+            return {"param_stats": {}, "update_stats": {},
+                    "activation_stats": {},
+                    "embedding_version": emb.get("version")}
         return {"param_stats": latest.param_stats,
-                "update_stats": latest.update_stats}
+                "update_stats": latest.update_stats,
+                "activation_stats": getattr(latest, "activation_stats", {}),
+                # lets the page detect a (re)published embedding without
+                # downloading the full scatter every poll
+                "embedding_version": emb.get("version")}

@@ -37,6 +37,7 @@ class StatsReport:
     memory_rss_mb: Optional[float] = None
     param_stats: Dict[str, dict] = field(default_factory=dict)
     update_stats: Dict[str, dict] = field(default_factory=dict)
+    activation_stats: Dict[str, dict] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -51,6 +52,7 @@ class StatsReport:
             "memory_rss_mb": self.memory_rss_mb,
             "param_stats": self.param_stats,
             "update_stats": self.update_stats,
+            "activation_stats": self.activation_stats,
         }
 
     @staticmethod
@@ -90,7 +92,14 @@ class StatsListener(TrainingListener):
 
     def __init__(self, storage, frequency: int = 10, histograms: bool = True,
                  bins: int = 20, session_id: Optional[str] = None,
-                 worker_id: str = "worker_0", collect_updates: bool = True):
+                 worker_id: str = "worker_0", collect_updates: bool = True,
+                 activation_probe=None):
+        """``activation_probe``: optional features array (or list of
+        arrays for graphs); when given, each sampled iteration runs a
+        forward pass on it and records per-layer ACTIVATION statistics —
+        the reference's activation histograms (BaseStatsListener gathers
+        them from stateful layers; the functional step stores none, so an
+        explicit probe batch is the honest equivalent; keep it small)."""
         self.storage = storage
         self.frequency = max(1, frequency)
         self.histograms = histograms
@@ -98,8 +107,44 @@ class StatsListener(TrainingListener):
         self.session_id = session_id or f"session_{int(time.time())}"
         self.worker_id = worker_id
         self.collect_updates = collect_updates
+        self.activation_probe = activation_probe
+        self._probe_warned = False
         self._prev_params = None
         self._last_time = None
+
+    def _activation_stats(self, net) -> Dict[str, dict]:
+        if self.activation_probe is None:
+            return {}
+        probe = self.activation_probe
+        try:
+            if isinstance(probe, (list, tuple)):   # ComputationGraph
+                acts = net.feed_forward(*probe)
+            else:
+                acts = net.feed_forward(probe)
+        except Exception as e:
+            # a misconfigured probe (wrong feature width, wrong arity)
+            # must be DIAGNOSABLE, not silently absent from the dashboard
+            if not self._probe_warned:
+                import warnings
+                warnings.warn(
+                    f"StatsListener activation_probe forward failed "
+                    f"({type(e).__name__}: {e}) — activation stats "
+                    f"disabled for this run", UserWarning)
+                self._probe_warned = True
+            return {}
+        if isinstance(acts, dict):
+            # graph feed_forward seeds the dict with the raw INPUTS —
+            # exclude them, they are probe data, not layer activations
+            inputs = set(getattr(getattr(net, "conf", None),
+                                 "network_inputs", ()) or ())
+            named = [(k, v) for k, v in acts.items() if k not in inputs]
+        else:
+            names = [getattr(l, "name", f"layer_{i}")
+                     for i, l in enumerate(net.layers)]
+            named = list(zip(names, acts))
+        return {str(k): _array_stats(np.asarray(v), self.histograms,
+                                     self.bins)
+                for k, v in named}
 
     def iteration_done(self, net, iteration, epoch):
         now = time.perf_counter()
@@ -149,5 +194,6 @@ class StatsListener(TrainingListener):
             memory_rss_mb=_rss_mb(),
             param_stats=param_stats,
             update_stats=update_stats,
+            activation_stats=self._activation_stats(net),
         )
         self.storage.put_update(report)

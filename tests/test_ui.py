@@ -341,3 +341,90 @@ def test_embedding_tab_publish_and_fetch():
         assert 'id="emb"' in page and "refreshEmbedding" in page
     finally:
         server.stop()
+
+
+def test_activation_stats_probe():
+    """Activation statistics (the reference UI's activation histograms):
+    a probe batch on the listener records per-layer activation stats for
+    MLN (list) and ComputationGraph (dict) forwards."""
+    import urllib.request
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       UIServer)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(Dense(n_in=6, n_out=8, activation="tanh"))
+            .layer(Output(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1,
+                                    session_id="act_sess", worker_id="w",
+                                    activation_probe=x[:8]))
+    for _ in range(2):
+        net.fit_batch(DataSet(x, y))
+    latest = storage.get_latest_update("act_sess")
+    assert latest.activation_stats, "no activation stats recorded"
+    for name, st in latest.activation_stats.items():
+        assert "mean_magnitude" in st and "histogram" in st
+    # tanh layer activations live in [-1, 1]
+    first = list(latest.activation_stats.values())[0]
+    assert -1.001 <= first["min"] and first["max"] <= 1.001
+
+    server = UIServer(port=0)
+    try:
+        server.attach(storage)
+        with urllib.request.urlopen(
+                server.url + "api/model?session=act_sess", timeout=30) as r:
+            m = json.loads(r.read().decode())
+        assert m["activation_stats"]
+        with urllib.request.urlopen(server.url, timeout=30) as r:
+            assert 'value="activation"' in r.read().decode()
+    finally:
+        server.stop()
+
+
+def test_activation_probe_graph_excludes_inputs_and_warns_on_bad_probe():
+    import warnings
+
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+
+    g = (NeuralNetConfiguration.builder().seed(2).graph_builder()
+         .add_inputs("inp")
+         .add_layer("d", Dense(n_in=4, n_out=6, activation="tanh"), "inp")
+         .add_layer("out", Output(n_in=6, n_out=2, activation="softmax",
+                                  loss="mcxent"), "d")
+         .set_outputs("out").build())
+    net = ComputationGraph(g).init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1,
+                                    session_id="g_act", worker_id="w",
+                                    activation_probe=[x[:4]]))
+    net.fit_batch(MultiDataSet([x], [y]))
+    st = storage.get_latest_update("g_act").activation_stats
+    assert "d" in st and "out" in st
+    assert "inp" not in st, "raw probe input leaked into activation stats"
+
+    # wrong-width probe: one warning, stats empty, training unaffected
+    net2 = ComputationGraph(g).init()
+    storage2 = InMemoryStatsStorage()
+    net2.set_listeners(StatsListener(storage2, frequency=1,
+                                     session_id="g_bad", worker_id="w",
+                                     activation_probe=[x[:, :3]]))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        net2.fit_batch(MultiDataSet([x], [y]))
+        net2.fit_batch(MultiDataSet([x], [y]))
+    probe_warnings = [m for m in w if "activation_probe" in str(m.message)]
+    assert len(probe_warnings) == 1, probe_warnings
+    assert storage2.get_latest_update("g_bad").activation_stats == {}
