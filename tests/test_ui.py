@@ -3,6 +3,7 @@
 
 import json
 import os
+import time
 import urllib.error
 import urllib.request
 
@@ -428,3 +429,34 @@ def test_activation_probe_graph_excludes_inputs_and_warns_on_bad_probe():
     probe_warnings = [m for m in w if "activation_probe" in str(m.message)]
     assert len(probe_warnings) == 1, probe_warnings
     assert storage2.get_latest_update("g_bad").activation_stats == {}
+
+
+def test_ui_cli_main_parses_and_attaches(tmp_path):
+    """python -m deeplearning4j_tpu.ui (PlayUIServer --uiPort parity):
+    flag parsing + file-storage attach, exercised in-process."""
+    import threading
+
+    from deeplearning4j_tpu.ui import FileStatsStorage, UIServer
+    from deeplearning4j_tpu.ui.__main__ import main as ui_main
+    from deeplearning4j_tpu.ui.stats import StatsReport
+
+    # write a JSONL log the CLI should surface
+    path = str(tmp_path / "run.jsonl")
+    fs = FileStatsStorage(path)
+    fs.put_update(StatsReport("cli_sess", "w", 1.0, 0, 0, 0.5))
+    fs.close()
+
+    t = threading.Thread(target=ui_main,
+                         args=(["--port", "0", "--file", path],),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    server = None
+    while time.time() < deadline and server is None:
+        server = UIServer._instance
+        time.sleep(0.1)
+    assert server is not None, "CLI server did not come up"
+    try:
+        assert "cli_sess" in server.sessions_payload()["sessions"]
+    finally:
+        server.stop()
