@@ -115,6 +115,13 @@ Notes:
   (``datasets/fetchers.py``). Drop the standard
   ``train-images-idx3-ubyte`` files into ``~/.deeplearning4j_tpu/mnist/``
   and the same command records the real-MNIST number.
+- Round-4 re-attempt (VERDICT asked for the IDX files as committed
+  fixtures): a full filesystem scan found no cached MNIST anywhere
+  (keras/TF/HF/torch caches all empty) and a live download attempt via
+  ``keras.datasets.mnist`` fails with DNS resolution disabled — the
+  files physically cannot be obtained from inside this sandbox. The
+  fetcher's real-IDX path itself is exercised by tests on generated IDX
+  fixtures (tests/test_native_io.py).
 - The **real-data** bar is met on scikit-learn's bundled handwritten
   digits (1,797 real scans, 8x8): same entry path, held-out test split.
 

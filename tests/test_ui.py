@@ -450,13 +450,19 @@ def test_ui_cli_main_parses_and_attaches(tmp_path):
                          args=(["--port", "0", "--file", path],),
                          daemon=True)
     t.start()
+    # poll for the SESSION, not the singleton: _instance is assigned
+    # before main() attaches the file storage
     deadline = time.time() + 30
-    server = None
-    while time.time() < deadline and server is None:
+    seen = False
+    while time.time() < deadline and not seen:
         server = UIServer._instance
-        time.sleep(0.1)
-    assert server is not None, "CLI server did not come up"
+        if server is not None and "cli_sess" in (
+                server.sessions_payload()["sessions"]):
+            seen = True
+        else:
+            time.sleep(0.1)
     try:
-        assert "cli_sess" in server.sessions_payload()["sessions"]
+        assert seen, "CLI server never surfaced the attached session"
     finally:
-        server.stop()
+        if UIServer._instance is not None:
+            UIServer._instance.stop()
