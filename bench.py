@@ -190,17 +190,18 @@ def main():
                 # deterministic crash must not cost a second full run
                 if attempt == 0 and any(
                         sig in proc.stderr for sig in
-                        ("response body closed", "remote_compile",
-                         "DEADLINE_EXCEEDED", "UNAVAILABLE")):
+                        ("response body closed", "DEADLINE_EXCEEDED",
+                         "UNAVAILABLE")):
                     continue
                 break
             try:
                 results[name] = json.loads(
                     proc.stdout.strip().splitlines()[-1])
             except (ValueError, IndexError):
+                # deterministic output problem — no retry
                 results[name] = {"error": "child produced no JSON: "
                                  + proc.stdout.strip()[-300:]}
-                continue  # retry once
+                break
             break
 
     primary = results.get("resnet50", {})

@@ -8,6 +8,8 @@ from deeplearning4j_tpu.datasets.iterator import (
     ArrayDataSetIterator,
     AsyncDataSetIterator,
     MultipleEpochsIterator,
+    SamplingDataSetIterator,
+    ReconstructionDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.fetchers import (
     CifarDataSetIterator,

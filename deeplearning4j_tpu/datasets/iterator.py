@@ -211,3 +211,62 @@ class NativeDataSetIterator(DataSetIterator):
 
     def close(self):
         self._loader.close()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Batches sampled WITH replacement from a source DataSet
+    (SamplingDataSetIterator.java parity: bootstrap-style batches for a
+    fixed number of iterations per epoch)."""
+
+    def __init__(self, dataset, batch_size: int, total_batches: int,
+                 seed: int = 0):
+        self._x = np.asarray(dataset.features)
+        self._y = (None if dataset.labels is None
+                   else np.asarray(dataset.labels))
+        self._batch_size = int(batch_size)
+        self.total_batches = int(total_batches)
+        self._seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        # fresh-but-deterministic draws each epoch
+        rng = np.random.default_rng(self._seed + self._epoch)
+        self._epoch += 1
+        n = len(self._x)
+        for _ in range(self.total_batches):
+            idx = rng.integers(0, n, self._batch_size)
+            yield DataSet(self._x[idx],
+                          None if self._y is None else self._y[idx])
+
+    def reset(self):
+        """Restart the stream: replay yields the epoch-0 draws again (the
+        DataSetIterator contract)."""
+        self._epoch = 0
+
+    def __len__(self):
+        return self.total_batches
+
+    @property
+    def batch_size(self):
+        return self._batch_size
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Wraps an iterator, replacing labels with the features —
+    autoencoder reconstruction targets (ReconstructionDataSetIterator
+    .java parity)."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def __iter__(self):
+        for ds in self.base:
+            yield DataSet(ds.features, ds.features)
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size

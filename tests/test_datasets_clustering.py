@@ -305,3 +305,53 @@ class TestLfwCurvesFetchers:
                  x=np.random.default_rng(1).random((32, 28, 28)))
         ds2, desc = CurvesDataFetcher().fetch(path=str(tmp_path / "curves.npz"))
         assert not desc.synthetic and desc.num_examples == 32
+
+
+class TestSamplingReconstructionIterators:
+    def test_sampling_with_replacement(self):
+        from deeplearning4j_tpu.datasets import SamplingDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(10, 3)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 10)])
+        it = SamplingDataSetIterator(ds, batch_size=32, total_batches=4,
+                                     seed=7)
+        batches = list(it)
+        assert len(batches) == 4 and len(it) == 4
+        # batch larger than the source forces replacement
+        assert all(np.asarray(b.features).shape == (32, 3) for b in batches)
+        # deterministic but epoch-varying draws
+        again = list(SamplingDataSetIterator(ds, 32, 4, seed=7))
+        np.testing.assert_array_equal(np.asarray(batches[0].features),
+                                      np.asarray(again[0].features))
+        second_epoch = list(it)
+        assert not np.array_equal(np.asarray(batches[0].features),
+                                  np.asarray(second_epoch[0].features))
+
+    def test_reconstruction_labels_are_features(self):
+        from deeplearning4j_tpu.datasets import (ArrayDataSetIterator,
+                                                 ReconstructionDataSetIterator)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(12, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+        it = ReconstructionDataSetIterator(
+            ArrayDataSetIterator(x, y, batch_size=4, shuffle=False))
+        for ds in it:
+            np.testing.assert_array_equal(np.asarray(ds.features),
+                                          np.asarray(ds.labels))
+        assert it.batch_size == 4
+
+    def test_sampling_reset_and_unlabeled(self):
+        from deeplearning4j_tpu.datasets import SamplingDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(2)
+        ds = DataSet(rng.normal(size=(6, 2)).astype(np.float32), None)
+        it = SamplingDataSetIterator(ds, batch_size=4, total_batches=2,
+                                     seed=3)
+        first = [np.asarray(b.features) for b in it]
+        assert all(b.labels is None for b in
+                   SamplingDataSetIterator(ds, 4, 2, seed=3))
+        it.reset()
+        replay = [np.asarray(b.features) for b in it]
+        for a, b in zip(first, replay):
+            np.testing.assert_array_equal(a, b)
