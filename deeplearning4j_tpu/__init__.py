@@ -26,3 +26,4 @@ from deeplearning4j_tpu.nn.conf import (
     MultiLayerConfiguration,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
