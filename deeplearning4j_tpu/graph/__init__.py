@@ -1,5 +1,6 @@
 """Graph embeddings (parity: deeplearning4j-graph, 2,293 LoC — SURVEY.md
-§2.7): graph API, random-walk iterators, DeepWalk."""
+§2.7): graph API, random-walk iterators, DeepWalk, and a real node2vec
+(stub-only in the reference)."""
 
 from deeplearning4j_tpu.graph.graph import Graph
 from deeplearning4j_tpu.graph.walks import (
@@ -7,3 +8,4 @@ from deeplearning4j_tpu.graph.walks import (
     WeightedRandomWalkIterator,
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+from deeplearning4j_tpu.graph.node2vec import Node2Vec, Node2VecWalkIterator

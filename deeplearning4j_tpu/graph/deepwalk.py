@@ -33,17 +33,23 @@ class DeepWalk:
         self.seed = seed
         self.vectors: SequenceVectors | None = None
 
+    def _default_walks(self, graph):
+        return RandomWalkIterator(
+            graph, self.walk_length, seed=self.seed,
+            walks_per_vertex=self.walks_per_vertex)
+
+    def _config(self) -> SequenceVectorsConfig:
+        return SequenceVectorsConfig(
+            vector_size=self.vector_size, window=self.window,
+            min_word_frequency=1, epochs=self.epochs,
+            learning_rate=self.learning_rate, negative=0, seed=self.seed)
+
     def fit(self, graph, walk_iterator=None):
         """DeepWalk.fit(IGraph, walkLength) parity."""
         if walk_iterator is None:
-            walk_iterator = RandomWalkIterator(
-                graph, self.walk_length, seed=self.seed,
-                walks_per_vertex=self.walks_per_vertex)
+            walk_iterator = self._default_walks(graph)
         walks = [[str(v) for v in walk] for walk in walk_iterator]
-        self.vectors = SequenceVectors(SequenceVectorsConfig(
-            vector_size=self.vector_size, window=self.window,
-            min_word_frequency=1, epochs=self.epochs,
-            learning_rate=self.learning_rate, negative=0, seed=self.seed))
+        self.vectors = SequenceVectors(self._config())
         self.vectors.build_vocab(walks)
         self.vectors.fit(walks)
         return self

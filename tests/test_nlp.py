@@ -249,3 +249,65 @@ def test_cjk_char_tokenizer():
     from deeplearning4j_tpu.nlp.tokenization import LowCasePreprocessor
     f.set_token_pre_processor(LowCasePreprocessor())
     assert f.create("ABC 語").get_tokens() == ["abc", "語"]
+
+
+class TestNode2Vec:
+    @staticmethod
+    def _two_clique_graph():
+        from deeplearning4j_tpu.graph import Graph
+        edges = []
+        for i in range(6):
+            for j in range(i + 1, 6):
+                edges.append((i, j))
+        for i in range(6, 12):
+            for j in range(i + 1, 12):
+                edges.append((i, j))
+        edges.append((5, 6))
+        return Graph.from_edge_list(edges)
+
+    def test_biased_walks_and_clustering(self):
+        """node2vec (real algorithm where the reference only stubs
+        models/node2vec/): biased walks cluster the two cliques."""
+        from deeplearning4j_tpu.graph import Node2Vec
+        g = self._two_clique_graph()
+        # fixed-seed config (tiny-graph embeddings are seed-sensitive;
+        # the biased-walk STATISTICS are asserted seed-robustly below)
+        n2v = Node2Vec(vector_size=16, window=4, walk_length=20,
+                       walks_per_vertex=16, p=1.0, q=2.0, epochs=8,
+                       seed=3).fit(g)
+        in_pairs = np.mean([n2v.similarity(0, 1), n2v.similarity(2, 3),
+                            n2v.similarity(7, 8), n2v.similarity(9, 10)])
+        cross = np.mean([n2v.similarity(0, 11), n2v.similarity(1, 9),
+                         n2v.similarity(3, 8), n2v.similarity(2, 10)])
+        assert in_pairs > cross + 0.1, (in_pairs, cross)
+        nearest = [v for v, _ in n2v.verts_nearest(8, top_n=3)]
+        assert all(v >= 6 for v in nearest), nearest
+
+    def test_walk_bias_statistics(self):
+        """Low q must EXPLORE (fewer immediate returns than high q) —
+        the (p, q) bias doing its job, checked statistically."""
+        from deeplearning4j_tpu.graph import Graph, Node2VecWalkIterator
+        # star graph with a tail: returns vs exploration are distinguishable
+        g = Graph.from_edge_list([(0, i) for i in range(1, 8)]
+                                 + [(1, 8), (8, 9)])
+
+        def return_rate(p, q, seed=0):
+            it = Node2VecWalkIterator(g, walk_length=30, p=p, q=q,
+                                      walks_per_vertex=30, seed=seed)
+            returns = steps = 0
+            for walk in it:
+                for i in range(2, len(walk)):
+                    steps += 1
+                    if walk[i] == walk[i - 2]:
+                        returns += 1
+            return returns / max(steps, 1)
+
+        high_return = return_rate(p=0.25, q=4.0)   # BFS-ish: cheap returns
+        low_return = return_rate(p=4.0, q=0.25)    # DFS-ish: returns costly
+        assert high_return > low_return + 0.1, (high_return, low_return)
+
+    def test_p_q_validation(self):
+        from deeplearning4j_tpu.graph import Graph, Node2VecWalkIterator
+        g = Graph.from_edge_list([(0, 1)])
+        with pytest.raises(ValueError):
+            Node2VecWalkIterator(g, 10, p=0.0)
