@@ -23,13 +23,14 @@ class DeepWalk:
     def __init__(self, vector_size: int = 100, window: int = 5,
                  walk_length: int = 40, walks_per_vertex: int = 1,
                  learning_rate: float = 0.025, epochs: int = 1,
-                 seed: int = 42):
+                 negative: int = 0, seed: int = 42):
         self.vector_size = vector_size
         self.window = window
         self.walk_length = walk_length
         self.walks_per_vertex = walks_per_vertex
         self.learning_rate = learning_rate
         self.epochs = epochs
+        self.negative = negative
         self.seed = seed
         self.vectors: SequenceVectors | None = None
 
@@ -42,7 +43,8 @@ class DeepWalk:
         return SequenceVectorsConfig(
             vector_size=self.vector_size, window=self.window,
             min_word_frequency=1, epochs=self.epochs,
-            learning_rate=self.learning_rate, negative=0, seed=self.seed)
+            learning_rate=self.learning_rate, negative=self.negative,
+            seed=self.seed)
 
     def fit(self, graph, walk_iterator=None):
         """DeepWalk.fit(IGraph, walkLength) parity."""

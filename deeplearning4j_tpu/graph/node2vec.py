@@ -23,7 +23,6 @@ from typing import List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk
-from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectorsConfig
 
 
 class Node2VecWalkIterator:
@@ -90,19 +89,11 @@ class Node2Vec(DeepWalk):
                          walk_length=walk_length,
                          walks_per_vertex=walks_per_vertex,
                          learning_rate=learning_rate, epochs=epochs,
-                         seed=seed)
+                         negative=negative, seed=seed)
         self.p = p
         self.q = q
-        self.negative = negative
 
     def _default_walks(self, graph):
         return Node2VecWalkIterator(
             graph, self.walk_length, p=self.p, q=self.q,
             walks_per_vertex=self.walks_per_vertex, seed=self.seed)
-
-    def _config(self) -> SequenceVectorsConfig:
-        return SequenceVectorsConfig(
-            vector_size=self.vector_size, window=self.window,
-            min_word_frequency=1, epochs=self.epochs,
-            learning_rate=self.learning_rate, negative=self.negative,
-            seed=self.seed)

@@ -69,6 +69,9 @@ select{margin-left:12px}
  <div class="card" id="embcard" style="display:none">
    <h3>Embedding map (t-SNE)</h3><svg id="emb" style="height:320px"></svg>
  </div>
+ <div class="card" id="flowcard" style="display:none">
+   <h3>Model flow</h3><svg id="flow" style="height:auto"></svg>
+ </div>
 </div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
@@ -146,6 +149,7 @@ async function refresh(){
   document.getElementById("model").innerHTML = rows + "</table>";
   renderHistogram(m);
   await refreshEmbedding(sess, m.embedding_version ?? null);
+  await refreshFlow(sess, m.activation_stats || {});
 }
 let lastModel = null;
 function renderHistogram(m){
@@ -190,6 +194,58 @@ function renderHistogram(m){
 }
 document.getElementById("histparam").onchange = ()=>renderHistogram();
 document.getElementById("histkind").onchange = ()=>renderHistogram();
+let flowCache = null;
+async function refreshFlow(sess, actStats){
+  // topology is static per session: fetch once (but keep re-fetching
+  // while null — the model info may be posted after the first poll)
+  if (flowCache !== sess || !window._flowModel){
+    const f = await (await fetch("/api/flow?session="+
+                     encodeURIComponent(sess))).json();
+    flowCache = sess;
+    window._flowModel = f.model;
+  }
+  const model = window._flowModel;
+  const card = document.getElementById("flowcard");
+  if (!model || !model.layers || !model.layers.length){
+    card.style.display = "none"; return;
+  }
+  card.style.display = "";
+  const el = document.getElementById("flow");
+  const BW = 190, BH = 34, GAP = 14, P = 10;
+  const layers = model.layers;
+  const H = P*2 + layers.length*(BH+GAP);
+  el.setAttribute("viewBox", `0 0 420 ${H}`);
+  el.style.height = Math.min(H, 600) + "px";
+  const ypos = {};
+  layers.forEach((l, i)=>{ ypos[l.name] = P + i*(BH+GAP); });
+  // color boxes by activation mean |a| when the probe publishes it
+  const mags = {};
+  let mmax = 0;
+  for (const [k, v] of Object.entries(actStats || {})){
+    mags[k] = v.mean_magnitude; mmax = Math.max(mmax, v.mean_magnitude);
+  }
+  let html = "";
+  layers.forEach((l)=>{
+    const y = ypos[l.name];
+    (l.inputs||[]).forEach(src=>{
+      if (src in ypos)
+        html += `<line x1="${P+BW/2}" y1="${ypos[src]+BH}"`+
+          ` x2="${P+BW/2}" y2="${y}" stroke="#999"`+
+          ` marker-end="none"/>`;
+    });
+    const m = mags[l.name];
+    const shade = (m != null && mmax > 0) ?
+      Math.round(235 - 140*(m/mmax)) : 235;
+    html += `<rect x="${P}" y="${y}" width="${BW}" height="${BH}" rx="5"`+
+      ` fill="rgb(${shade},${shade},255)" stroke="#1a237e"/>`+
+      `<text x="${P+8}" y="${y+14}" font-size="11" font-weight="600">`+
+      `${esc(l.name)}</text>`+
+      `<text x="${P+8}" y="${y+27}" font-size="10" fill="#555">`+
+      `${esc(l.type)} · ${l.params.toLocaleString()} params`+
+      `${m != null ? " · |a| "+Number(m).toPrecision(3) : ""}</text>`;
+  });
+  el.innerHTML = html;
+}
 let embCache = {sess: null, version: null};
 async function refreshEmbedding(sess, version){
   // fetch + rebuild the scatter only when a (re)published embedding's
@@ -264,6 +320,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.model_payload(q.get("session", "")))
         elif url.path == "/api/embedding":
             self._json(ui.embedding_payload(q.get("session", "")))
+        elif url.path == "/api/flow":
+            self._json(ui.flow_payload(q.get("session", "")))
         else:
             self._json({"error": "not found"}, 404)
 
@@ -392,6 +450,16 @@ class UIServer:
             "workers": workers,
             "latest": latest,
         }
+
+    def flow_payload(self, session_id: str) -> dict:
+        """Model topology for the flow view (the reference UI's
+        flow/model tabs): first worker's posted static model info."""
+        for s in self.storages:
+            for wid in s.list_worker_ids_for_session(session_id):
+                info = s.get_static_info(session_id, wid)
+                if info and "model" in info:
+                    return {"model": info["model"], "worker": wid}
+        return {"model": None, "worker": None}
 
     def embedding_payload(self, session_id: str) -> dict:
         """Published 2-D embedding scatter for the session (the reference

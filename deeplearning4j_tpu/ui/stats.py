@@ -109,8 +109,63 @@ class StatsListener(TrainingListener):
         self.collect_updates = collect_updates
         self.activation_probe = activation_probe
         self._probe_warned = False
+        self._model_posted = False
         self._prev_params = None
         self._last_time = None
+
+    def _post_model_info(self, net):
+        """Once per run: describe the model topology for the dashboard's
+        flow view (the reference UI's flow/model tabs render exactly
+        this: layer boxes with types/param counts, wired by the graph)."""
+        if self._model_posted:
+            return
+        self._model_posted = True
+        try:
+            layers = []
+            params = net.params or {}
+            is_graph = hasattr(net, "topo")
+            if is_graph:
+                for name in net.topo:
+                    kind = net.vertex_kind.get(name)
+                    if kind == "layer":
+                        ltype = type(net._layer_by_name[name]).__name__
+                    else:
+                        ltype = type(net._resolved_confs[name]).__name__
+                    n_params = int(sum(
+                        np.asarray(v).size
+                        for v in params.get(name, {}).values()))
+                    layers.append({
+                        "name": str(name), "type": ltype,
+                        "params": n_params,
+                        "inputs": [str(i) for i in
+                                   net.conf.vertex_inputs.get(name, [])],
+                    })
+                inputs = [str(i) for i in net.conf.network_inputs]
+            else:
+                prev = None
+                for layer in net.layers:
+                    n_params = int(sum(
+                        np.asarray(v).size
+                        for v in params.get(layer.name, {}).values()))
+                    layers.append({
+                        "name": str(layer.name),
+                        "type": type(layer).__name__,
+                        "params": n_params,
+                        "inputs": [prev] if prev else [],
+                    })
+                    prev = str(layer.name)
+                inputs = []
+            self.storage.put_static_info(self.session_id, self.worker_id, {
+                "model": {"layers": layers, "network_inputs": inputs},
+            })
+        except Exception as e:
+            # must never break training — but must be DIAGNOSABLE (the
+            # flow tab silently missing is a debugging dead end)
+            import warnings
+            warnings.warn(
+                f"StatsListener model-topology post failed "
+                f"({type(e).__name__}: {e}) — the dashboard flow view "
+                f"will be empty for this run", UserWarning)
 
     def _activation_stats(self, net) -> Dict[str, dict]:
         if self.activation_probe is None:
@@ -147,6 +202,7 @@ class StatsListener(TrainingListener):
                 for k, v in named}
 
     def iteration_done(self, net, iteration, epoch):
+        self._post_model_info(net)
         now = time.perf_counter()
         iter_ms = None
         if self._last_time is not None:

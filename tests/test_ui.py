@@ -466,3 +466,67 @@ def test_ui_cli_main_parses_and_attaches(tmp_path):
     finally:
         if UIServer._instance is not None:
             UIServer._instance.stop()
+
+
+def test_flow_view_model_topology():
+    """The reference UI's flow/model tabs: the listener posts the model
+    topology once; /api/flow serves layer boxes with types/params/wiring
+    for both MLN (sequential) and ComputationGraph (DAG)."""
+    import urllib.request
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       UIServer)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(Dense(n_in=6, n_out=8, activation="tanh"))
+            .layer(Output(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1,
+                                    session_id="flow_sess", worker_id="w"))
+    net.fit_batch(DataSet(x, y))
+    info = storage.get_static_info("flow_sess", "w")
+    assert info and "model" in info
+    layers = info["model"]["layers"]
+    assert len(layers) == 2
+    assert layers[0]["params"] == 6 * 8 + 8          # dense W + b
+    assert layers[1]["inputs"] == [layers[0]["name"]]
+
+    # DAG wiring: add vertex carries both inputs
+    from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex
+    g = (NeuralNetConfiguration.builder().seed(2).graph_builder()
+         .add_inputs("a")
+         .add_layer("d1", Dense(n_in=6, n_out=4, activation="tanh"), "a")
+         .add_layer("d2", Dense(n_in=6, n_out=4, activation="tanh"), "a")
+         .add_vertex("sum", ElementWiseVertex(op="add"), "d1", "d2")
+         .add_layer("out", Output(n_in=4, n_out=2, activation="softmax",
+                                  loss="mcxent"), "sum")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g).init()
+    cg.set_listeners(StatsListener(storage, frequency=1,
+                                   session_id="flow_g", worker_id="w"))
+    cg.fit_batch(MultiDataSet([x], [y]))
+    gm = storage.get_static_info("flow_g", "w")["model"]
+    by_name = {l["name"]: l for l in gm["layers"]}
+    assert sorted(by_name["sum"]["inputs"]) == ["d1", "d2"]
+    assert gm["network_inputs"] == ["a"]
+
+    server = UIServer(port=0)
+    try:
+        server.attach(storage)
+        with urllib.request.urlopen(
+                server.url + "api/flow?session=flow_g", timeout=30) as r:
+            f = json.loads(r.read().decode())
+        assert f["model"] and len(f["model"]["layers"]) == 4
+        with urllib.request.urlopen(server.url, timeout=30) as r:
+            page = r.read().decode()
+        assert 'id="flow"' in page and "refreshFlow" in page
+    finally:
+        server.stop()
