@@ -87,13 +87,50 @@ def digits_net():
             "test_accuracy": round(ev.accuracy(), 4)}
 
 
+def resnet18_cifar():
+    """ResNet-18/CIFAR convergence smoke (BASELINE config #5's model):
+    the residual stack + batch-norm chain must actually LEARN — this run
+    is the regression guard for the round-4 zoo fix (BN layers used to
+    inherit the global sigmoid default, silently squashing every BN
+    output)."""
+    from deeplearning4j_tpu import zoo
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.datasets.fetchers import CifarDataSetIterator
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.nn.updater import Adam
+
+    train_it = CifarDataSetIterator(batch_size=128, train=True,
+                                    num_examples=4096)
+    test_it = CifarDataSetIterator(batch_size=512, train=False,
+                                   num_examples=1024)
+    synthetic = train_it.descriptor.synthetic
+    net = zoo.resnet18(updater=Adam(1e-3))
+    t0 = time.time()
+    for _ in range(3):
+        for ds in train_it:
+            x, y = np.asarray(ds.features), np.asarray(ds.labels)
+            net.fit_batch(MultiDataSet([x], [y]))
+        train_it.reset()
+    secs = time.time() - t0
+    ev = Evaluation(num_classes=10)
+    for ds in test_it:
+        out = np.asarray(net.output(np.asarray(ds.features)))
+        ev.eval(np.asarray(ds.labels), out)
+    return {"dataset": "CIFAR-10" + (" (SYNTHETIC fallback)" if synthetic
+                                     else " (real batches)"),
+            "synthetic": synthetic, "model": "zoo.resnet18 (bf16)",
+            "epochs": 3, "train_seconds": round(secs, 1),
+            "test_accuracy": round(ev.accuracy(), 4)}
+
+
 def main():
     import jax
     dev = jax.devices()[0]
     results = {"device": str(dev), "device_kind":
                getattr(dev, "device_kind", "?"),
                "mnist_lenet": mnist_lenet(),
-               "real_digits": digits_net()}
+               "real_digits": digits_net(),
+               "resnet18_cifar": resnet18_cifar()}
     print(json.dumps(results, indent=2))
 
     md = f"""# ACCEPTANCE — quality runs from the stock entry points
@@ -106,6 +143,7 @@ Recorded by ``scripts/acceptance.py`` on ``{results['device_kind']}``.
 |---|---|---|---|---|
 | real_digits | {results['real_digits']['dataset']} | {results['real_digits']['model']} | {results['real_digits']['epochs']} | **{results['real_digits']['test_accuracy']:.4f}** |
 | mnist_lenet | {results['mnist_lenet']['dataset']} | {results['mnist_lenet']['model']} | {results['mnist_lenet']['epochs']} | {results['mnist_lenet']['test_accuracy']:.4f} |
+| resnet18_cifar | {results['resnet18_cifar']['dataset']} | {results['resnet18_cifar']['model']} | {results['resnet18_cifar']['epochs']} | {results['resnet18_cifar']['test_accuracy']:.4f} |
 
 Notes:
 - This environment has **no network egress and no cached MNIST IDX
