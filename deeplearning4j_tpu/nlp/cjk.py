@@ -8,14 +8,20 @@ extension point the rest of the NLP stack uses. This module proves that
 seam with an actual analyzer rather than the char-bigram baseline
 (CJKCharTokenizerFactory):
 
-- ``DictionarySegmenter``: cost-based dynamic-programming segmentation
-  (the Viterbi-over-lattice core of MeCab/Kuromoji, minus
-  part-of-speech connection costs): every dictionary word spans an edge
-  with cost ``len-discounted``; unknown single characters get a penalty
-  cost, so known multi-character words win over character soup. A small
-  built-in Japanese function-word/common-noun lexicon is bundled; real
+- ``LatticeSegmenter``: the full Kuromoji algorithm — bigram
+  connection-cost Viterbi over a dictionary lattice
+  (viterbi/ViterbiBuilder.java + ViterbiSearcher.java), char-class-based
+  unknown-word insertion (CharacterDefinitions semantics: invoke/group
+  per class), and part-of-speech tags carried on every token
+  (``MorphToken``). Context disambiguates: すもももももももものうち
+  parses noun-particle-noun…, which no unigram cost model can produce.
+- ``DictionarySegmenter``: the lighter unigram tier (no connection
+  costs): every dictionary word spans an edge with cost
+  ``len-discounted``; unknown single characters get a penalty cost, so
+  known multi-character words win over character soup. A small built-in
+  Japanese function-word/common-noun lexicon is bundled; real
   deployments load a full lexicon with ``load_dictionary`` (one word per
-  line, optionally ``word<TAB>cost``).
+  line, optionally ``word<TAB>cost[<TAB>pos]``).
 - ``DictionaryTokenizerFactory``: the TokenizerFactory adapter — Han/Kana
   runs go through the segmenter, other text through whitespace rules;
   drop-in everywhere a DefaultTokenizerFactory is accepted (Word2Vec,
@@ -29,7 +35,9 @@ seam with an actual analyzer rather than the char-bigram baseline
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+import unicodedata
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from deeplearning4j_tpu.nlp.tokenization import (CJKCharTokenizerFactory,
                                                  DefaultTokenizerFactory)
@@ -132,14 +140,262 @@ class DictionarySegmenter:
         return out
 
 
+@dataclass(frozen=True)
+class MorphToken:
+    """One analyzed token: surface form + part of speech + whether it came
+    from the dictionary (ViterbiNode.Type.KNOWN) or the unknown-word
+    inserter (Type.UNKNOWN)."""
+    surface: str
+    pos: str
+    known: bool
+
+
+# Character classes for unknown-word handling, mirroring Kuromoji's
+# CharacterDefinitions (char.def): per class (invoke, group, per-char cost,
+# POS). ``invoke``: insert unknown nodes even when dictionary words match
+# at this position; ``group``: one node per maximal same-class run instead
+# of per character.
+_CHAR_CLASSES: Dict[str, Tuple[bool, bool, float, str]] = {
+    "KANJI": (False, False, 2.0, "noun"),
+    "HIRAGANA": (False, False, 2.5, "unk"),
+    "KATAKANA": (True, True, 1.0, "noun"),   # loanword runs are nouns
+    "LATIN": (True, True, 1.0, "noun"),
+    "NUMERIC": (True, True, 1.0, "noun"),
+    "DEFAULT": (False, False, 3.0, "unk"),
+}
+
+
+def _char_class(ch: str) -> str:
+    o = ord(ch)
+    if 0x3040 <= o <= 0x309F:
+        return "HIRAGANA"
+    if 0x30A0 <= o <= 0x30FF or 0x31F0 <= o <= 0x31FF or o == 0xFF70 \
+            or 0xFF66 <= o <= 0xFF9D:
+        return "KATAKANA"
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+        return "KANJI"
+    if ch.isascii() and ch.isalpha():
+        return "LATIN"
+    if unicodedata.category(ch) == "Nd":
+        return "NUMERIC"
+    return "DEFAULT"
+
+
+# Default bigram connection costs over POS classes (the ConnectionCosts
+# matrix tier — matrix.def in IPADIC, here a compact POS-level rendering:
+# grammatical transitions are cheap, ungrammatical ones expensive).
+_DEFAULT_CONNECTIONS: Dict[Tuple[str, str], float] = {
+    ("noun", "particle"): 0.0, ("noun", "aux"): 0.1, ("noun", "noun"): 2.0,
+    ("particle", "noun"): 0.0, ("particle", "verb"): 0.1,
+    ("particle", "adj"): 0.1, ("particle", "particle"): 1.0,
+    ("verb", "aux"): 0.0, ("verb", "particle"): 0.2,
+    ("adj", "aux"): 0.1, ("adj", "noun"): 0.3,
+    ("aux", "aux"): 0.1, ("aux", "particle"): 0.3,
+    ("adv", "verb"): 0.1, ("adv", "adj"): 0.1,
+    ("BOS", "particle"): 2.0, ("BOS", "aux"): 2.0,
+    ("particle", "EOS"): 1.5, ("noun", "EOS"): 0.1, ("verb", "EOS"): 0.0,
+    ("aux", "EOS"): 0.0, ("adj", "EOS"): 0.1,
+}
+
+# POS tags for the builtin starter lexicon (the TokenInfoDictionary tier).
+_BUILTIN_POS: Dict[str, str] = {}
+for _w in "は が を に で と も の へ から まで より".split():
+    _BUILTIN_POS[_w] = "particle"
+for _w in ("だ です ます でした した する して いる ある ない なかった "
+           "れる られる せる たい").split():
+    _BUILTIN_POS[_w] = "aux"
+for _w in ("食べる 飲む 行く 来る 見る 聞く 話す 読む 書く 買う 売る "
+           "作る").split():
+    _BUILTIN_POS[_w] = "verb"
+for _w in "好き 嫌い 大きい 小さい 新しい 古い 高い 安い 良い 悪い".split():
+    _BUILTIN_POS[_w] = "adj"
+for _w in "とても すこし たくさん".split():
+    _BUILTIN_POS[_w] = "adv"
+
+
+class LatticeSegmenter:
+    """Connection-cost lattice Viterbi — the full Kuromoji tier.
+
+    Upgrades DictionarySegmenter from unigram min-cost DP to the
+    reference's actual algorithm (viterbi/ViterbiBuilder.java:69 build +
+    ViterbiSearcher.java:68-117 search): every dictionary word spanning
+    [i, j) becomes a lattice node carrying a word cost AND a POS class;
+    path cost accumulates ``prev.path + connection(prev.pos, node.pos) +
+    node.word_cost`` (ViterbiSearcher.updateNode:102), so the winning
+    segmentation depends on grammatical CONTEXT, not just word lengths —
+    the thing a unigram model cannot do (すもももももももものうち segments
+    noun-particle-noun…, not noun-noun-noun). Unknown words follow
+    CharacterDefinitions semantics (ViterbiBuilder.processUnknownWord:127):
+    per character class, ``invoke`` inserts nodes even where dictionary
+    matches exist, ``group`` spans maximal same-class runs (katakana
+    loanwords, digits, latin), and each node carries the class's POS.
+
+    BOS/EOS are real lattice nodes (ViterbiLattice.addBos/addEos), so
+    sentence-position preferences participate in the search.
+    """
+
+    KNOWN_BONUS = 0.5
+
+    def __init__(self, entries: Optional[Iterable] = None,
+                 connections: Optional[Dict[Tuple[str, str], float]] = None,
+                 default_connection: float = 0.5):
+        self._entries: Dict[str, List[Tuple[str, float]]] = {}
+        self._max_len = 1
+        self._conn = dict(_DEFAULT_CONNECTIONS)
+        if connections:
+            self._conn.update(connections)
+        self._default_conn = float(default_connection)
+        if entries is None:
+            for w in _BUILTIN_JA:
+                self.add_word(w, pos=_BUILTIN_POS.get(w, "noun"))
+        else:
+            for e in entries:
+                if isinstance(e, str):
+                    self.add_word(e)
+                else:
+                    self.add_word(*e)
+
+    # ------------------------------------------------------------ lexicon
+    def add_word(self, word: str, pos: str = "noun",
+                 cost: Optional[float] = None) -> None:
+        if not word:
+            return
+        c = float(cost) if cost is not None else len(word) - self.KNOWN_BONUS
+        self._entries.setdefault(word, []).append((pos, c))
+        self._max_len = max(self._max_len, len(word))
+
+    def set_connection(self, left_pos: str, right_pos: str,
+                       cost: float) -> None:
+        self._conn[(left_pos, right_pos)] = float(cost)
+
+    def load_dictionary(self, path: str) -> "LatticeSegmenter":
+        """``word``, ``word<TAB>cost`` or ``word<TAB>cost<TAB>pos`` lines."""
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if not parts or not parts[0]:
+                    continue
+                cost = float(parts[1]) if len(parts) > 1 and parts[1] else None
+                pos = parts[2] if len(parts) > 2 else "noun"
+                self.add_word(parts[0], pos=pos, cost=cost)
+        return self
+
+    def __contains__(self, word: str) -> bool:
+        return word in self._entries
+
+    def connection(self, left_pos: str, right_pos: str) -> float:
+        return self._conn.get((left_pos, right_pos), self._default_conn)
+
+    # ------------------------------------------------------------ lattice
+    def _build(self, text: str):
+        """Lattice nodes (start, end, surface, pos, cost, known), grouped
+        by end position (the endIndexArr of ViterbiLattice.java)."""
+        n = len(text)
+        nodes: List[Tuple[int, int, str, str, float, bool]] = []
+        classes = [_char_class(c) for c in text]  # O(n), computed once
+        for start in range(n):
+            found = False
+            for L in range(1, min(self._max_len, n - start) + 1):
+                w = text[start:start + L]
+                for pos, cost in self._entries.get(w, ()):
+                    nodes.append((start, start + L, w, pos, cost, True))
+                    found = True
+            cls = classes[start]
+            invoke, group, char_cost, pos = _CHAR_CLASSES[cls]
+            if invoke or not found:
+                run_start = start == 0 or classes[start - 1] != cls
+                if group and run_start:
+                    # ONE grouped node per maximal same-class run
+                    # (Kuromoji inserts the grouped unknown at the run
+                    # head; O(total_chars) overall, not O(run^2))
+                    end = start + 1
+                    while end < n and classes[end] == cls:
+                        end += 1
+                    if end > start + 1:
+                        nodes.append((start, end, text[start:end], pos,
+                                      char_cost * (end - start), False))
+                # single-char node at EVERY position keeps mid-run
+                # dictionary words reachable (a word starting inside a
+                # grouped run needs an incoming edge at its start)
+                nodes.append((start, start + 1, text[start], pos,
+                              char_cost, False))
+        return nodes
+
+    def tokenize(self, text: str) -> List[MorphToken]:
+        """Best path through the lattice as analyzed tokens."""
+        n = len(text)
+        if n == 0:
+            return []
+        nodes = self._build(text)
+        ends: List[List[int]] = [[] for _ in range(n + 1)]
+        for idx, nd in enumerate(nodes):
+            ends[nd[1]].append(idx)
+        INF = float("inf")
+        path = [INF] * len(nodes)
+        back = [-1] * len(nodes)   # -1 = BOS, else node index
+        for idx, (start, _e, _w, pos, cost, _k) in enumerate(nodes):
+            if start == 0:
+                path[idx] = self.connection("BOS", pos) + cost
+                continue
+            best = INF
+            best_prev = None
+            for p in ends[start]:
+                if path[p] is INF:
+                    continue
+                cand = path[p] + self.connection(nodes[p][3], pos) + cost
+                if cand < best:
+                    best, best_prev = cand, p
+            if best_prev is not None:
+                path[idx] = best
+                back[idx] = best_prev
+        # EOS
+        best, best_last = INF, None
+        for p in ends[n]:
+            if path[p] is INF:
+                continue
+            cand = path[p] + self.connection(nodes[p][3], "EOS")
+            if cand < best:
+                best, best_last = cand, p
+        if best_last is None:   # unreachable: unknown singles make every
+            return [MorphToken(text, "unk", False)]  # position reachable
+        out: List[MorphToken] = []
+        idx = best_last
+        while idx != -1:
+            _s, _e, w, pos, _c, known = nodes[idx]
+            out.append(MorphToken(w, pos, known))
+            idx = back[idx]
+        out.reverse()
+        return out
+
+    def segment(self, text: str) -> List[str]:
+        """Surface forms of the best path (DictionarySegmenter-compatible,
+        so this drops into DictionaryTokenizerFactory unchanged)."""
+        return [t.surface for t in self.tokenize(text)]
+
+
 class DictionaryTokenizerFactory(CJKCharTokenizerFactory):
     """TokenizerFactory whose CJK runs are segmented by a
-    DictionarySegmenter instead of char bigrams — the Kuromoji-shaped
-    plug-in exercising the reference's extension point for real."""
+    DictionarySegmenter/LatticeSegmenter instead of char bigrams — the
+    Kuromoji-shaped plug-in exercising the reference's extension point
+    for real.
 
-    def __init__(self, segmenter: Optional[DictionarySegmenter] = None):
+    ``keep_pos``: optional POS whitelist (e.g. ``{"noun", "verb", "adj"}``)
+    applied to analyzed CJK tokens — the PoStagger annotator tier
+    (deeplearning4j-nlp-uima/.../text/annotator/PoStagger.java tags tokens
+    so downstream consumers can select by part of speech; here the lattice
+    carries the tags and the factory filters content words for Word2Vec /
+    TF-IDF). Requires a segmenter with ``tokenize`` (LatticeSegmenter);
+    non-CJK words pass through unfiltered."""
+
+    def __init__(self, segmenter=None, keep_pos=None):
         super().__init__()
         self.segmenter = segmenter or DictionarySegmenter()
+        if keep_pos is not None and not hasattr(self.segmenter, "tokenize"):
+            raise ValueError(
+                "keep_pos filtering needs a POS-aware segmenter "
+                "(LatticeSegmenter), not "
+                f"{type(self.segmenter).__name__}")
+        self.keep_pos = frozenset(keep_pos) if keep_pos is not None else None
 
     def create(self, text: str):
         # walk the text the same way the parent does, but route CJK runs
@@ -150,7 +406,13 @@ class DictionaryTokenizerFactory(CJKCharTokenizerFactory):
 
         def flush_run():
             if run:
-                tokens.extend(self.segmenter.segment("".join(run)))
+                if self.keep_pos is not None:
+                    tokens.extend(
+                        t.surface
+                        for t in self.segmenter.tokenize("".join(run))
+                        if t.pos in self.keep_pos)
+                else:
+                    tokens.extend(self.segmenter.segment("".join(run)))
                 run.clear()
 
         def flush_word():

@@ -42,6 +42,119 @@ class EndingPreProcessor:
         return token
 
 
+class StemmerPreProcessor:
+    """Porter stemmer as a token pre-process — the StemmerAnnotator tier
+    (deeplearning4j-nlp-uima/.../annotator/StemmerAnnotator.java wraps the
+    Snowball English stemmer as a UIMA pipeline stage; here the stemmer IS
+    the pre-process, pluggable into any TokenizerFactory via
+    set_token_pre_processor). Implements the classic Porter algorithm
+    (steps 1a-5b) rather than EndingPreProcessor's four-suffix strip."""
+
+    _VOWELS = set("aeiou")
+
+    def _cons(self, w: str, i: int) -> bool:
+        c = w[i]
+        if c in self._VOWELS:
+            return False
+        if c == "y":
+            return i == 0 or not self._cons(w, i - 1)
+        return True
+
+    def _measure(self, stem: str) -> int:
+        """Number of VC sequences (the m in Porter's [C](VC)^m[V])."""
+        m, prev_v = 0, False
+        for i in range(len(stem)):
+            v = not self._cons(stem, i)
+            if prev_v and not v:
+                m += 1
+            prev_v = v
+        return m
+
+    def _has_vowel(self, stem: str) -> bool:
+        return any(not self._cons(stem, i) for i in range(len(stem)))
+
+    def _cvc(self, stem: str) -> bool:
+        if len(stem) < 3:
+            return False
+        return (self._cons(stem, -1 + len(stem)) and
+                not self._cons(stem, len(stem) - 2) and
+                self._cons(stem, len(stem) - 3) and
+                stem[-1] not in "wxy")
+
+    def _repl(self, w, rules, cond=None):
+        """First matching (suffix, repl) rule whose stem passes ``cond``."""
+        for suf, repl in rules:
+            if w.endswith(suf):
+                stem = w[: len(w) - len(suf)]
+                if cond is None or cond(stem):
+                    return stem + repl
+                return w
+        return w
+
+    def pre_process(self, token: str) -> str:
+        w = token.lower()
+        if len(w) <= 2:
+            return w
+        # step 1a
+        w = self._repl(w, (("sses", "ss"), ("ies", "i"), ("ss", "ss"),
+                           ("s", "")))
+        # step 1b
+        if w.endswith("eed"):
+            stem = w[:-3]
+            if self._measure(stem) > 0:
+                w = w[:-1]
+        else:
+            for suf in ("ed", "ing"):
+                if w.endswith(suf) and self._has_vowel(w[: -len(suf)]):
+                    w = w[: -len(suf)]
+                    if w.endswith(("at", "bl", "iz")):
+                        w += "e"
+                    elif (len(w) > 1 and w[-1] == w[-2]
+                          and self._cons(w, len(w) - 1)
+                          and w[-1] not in "lsz"):
+                        w = w[:-1]
+                    elif self._measure(w) == 1 and self._cvc(w):
+                        w += "e"
+                    break
+        # step 1c
+        if w.endswith("y") and self._has_vowel(w[:-1]):
+            w = w[:-1] + "i"
+        # step 2
+        w = self._repl(w, (
+            ("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+            ("anci", "ance"), ("izer", "ize"), ("abli", "able"),
+            ("alli", "al"), ("entli", "ent"), ("eli", "e"), ("ousli", "ous"),
+            ("ization", "ize"), ("ation", "ate"), ("ator", "ate"),
+            ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+            ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"),
+            ("biliti", "ble")), lambda s: self._measure(s) > 0)
+        # step 3
+        w = self._repl(w, (
+            ("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+            ("ical", "ic"), ("ful", ""), ("ness", "")),
+            lambda s: self._measure(s) > 0)
+        # step 4
+        w = self._repl(w, (
+            ("al", ""), ("ance", ""), ("ence", ""), ("er", ""), ("ic", ""),
+            ("able", ""), ("ible", ""), ("ant", ""), ("ement", ""),
+            ("ment", ""), ("ent", ""), ("ou", ""), ("ism", ""), ("ate", ""),
+            ("iti", ""), ("ous", ""), ("ive", ""), ("ize", "")),
+            lambda s: self._measure(s) > 1)
+        if w.endswith(("sion", "tion")) and self._measure(w[:-3]) > 1:
+            w = w[:-3]
+        # step 5a
+        if w.endswith("e"):
+            stem = w[:-1]
+            m = self._measure(stem)
+            if m > 1 or (m == 1 and not self._cvc(stem)):
+                w = stem
+        # step 5b
+        if (len(w) > 1 and w[-1] == "l" and w[-2] == "l"
+                and self._measure(w) > 1):
+            w = w[:-1]
+        return w
+
+
 # ---------------------------------------------------------------------------
 # Tokenizers (text/tokenization/tokenizerfactory/ parity)
 # ---------------------------------------------------------------------------

@@ -25,6 +25,21 @@ from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import BaseLayerConfig
 from deeplearning4j_tpu.nn.updater import apply_layer_updates
 
+_REMAT_TAG = "dl4j_remat"
+
+
+def _remat_prefixes() -> tuple:
+    """Selective rematerialization scope: comma-separated vertex-name
+    prefixes (e.g. ``DL4J_TPU_REMAT=s0b`` drops every stage-1 block
+    activation from the saved residual set and recomputes them in the
+    backward). The TPU answer to activation-memory pressure at large
+    batch: trade cheap stage FLOPs for HBM residency (global remat was
+    measured unprofitable — PERF.md r3; this targets only the named
+    stages). Default off."""
+    import os
+    v = os.environ.get("DL4J_TPU_REMAT", "").strip()
+    return tuple(p for p in (s.strip() for s in v.split(",")) if p)
+
 
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration):
@@ -197,6 +212,15 @@ class ComputationGraph:
         masks = dict(fmasks or {})
         saved_inputs = {}
         new_state = dict(state)
+        remat = _remat_prefixes() if train else ()
+
+        def _tag(name, y):
+            """Mark a vertex activation droppable under selective remat
+            (only has effect inside the jax.checkpoint-wrapped loss)."""
+            if remat and any(name.startswith(p) for p in remat):
+                from jax.ad_checkpoint import checkpoint_name
+                return checkpoint_name(y, _REMAT_TAG)
+            return y
         from deeplearning4j_tpu.nn.conf.vertices import (
             DuplicateToTimeSeriesVertex, LastTimeStepVertex)
         # training walks route matched bottleneck tails through the fused
@@ -214,7 +238,7 @@ class ComputationGraph:
                 fb = plans[name]
                 y, bn_state_new = _fusion.execute_fused_tail(
                     fb, self, params, state, acts)
-                acts[name] = y
+                acts[name] = _tag(name, y)
                 masks[name] = None
                 new_state[fb.bn] = bn_state_new
                 continue
@@ -244,10 +268,10 @@ class ComputationGraph:
                                        mask=in_masks[0])
                 if s_new:
                     new_state[name] = s_new
-                acts[name] = y
+                acts[name] = _tag(name, y)
                 masks[name] = layer.feed_forward_mask(in_masks[0])
             else:
-                acts[name] = conf.forward(*xs, masks=in_masks)
+                acts[name] = _tag(name, conf.forward(*xs, masks=in_masks))
                 masks[name] = conf.feed_forward_mask(*in_masks)
         return acts, saved_inputs, masks, new_state
 
@@ -310,6 +334,15 @@ class ComputationGraph:
         def loss_fn(params, state, inputs, labels, fmasks, lmasks, rng):
             return self._loss(params, state, inputs, labels, fmasks, lmasks,
                               rng)
+
+        if _remat_prefixes():
+            # selective remat: save every residual EXCEPT the activations
+            # _walk tagged for the named stages; XLA recomputes those in
+            # the backward (activation-memory for stage FLOPs)
+            loss_fn = jax.checkpoint(
+                loss_fn,
+                policy=jax.checkpoint_policies.save_anything_except_these_names(
+                    _REMAT_TAG))
 
         def step_fn(params, state, opt_state, it, inputs, labels, fmasks,
                     lmasks, rng):

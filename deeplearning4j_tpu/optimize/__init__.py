@@ -8,4 +8,5 @@ from deeplearning4j_tpu.optimize.listeners import (
     PerformanceListener,
     ProfilerListener,
     CollectScoresIterationListener,
+    ParamAndGradientIterationListener,
 )

@@ -211,3 +211,50 @@ def test_lenet_learns_synthetic_mnist():
     net.fit(it, epochs=6, async_prefetch=False)
     acc = net.evaluate(DataSet(x, y)).accuracy()
     assert acc > 0.9, f"LeNet failed to learn: acc={acc}"
+
+
+class TestStride2Rewrites:
+    """The exact conv lowerings behind DL4J_TPU_S2D_STEM /
+    DL4J_TPU_SLICE_1X1 (PERF.md round 5) must match the direct
+    lax.conv lowering bit-for-bit in f32 — values AND gradients."""
+
+    def test_space_to_depth_matches_direct(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from deeplearning4j_tpu.ops.convolution import (
+            conv2d_space_to_depth, spatial_padding)
+        rng = np.random.default_rng(0)
+        for h, mode in ((28, "same"), (29, "same"), (28, "truncate")):
+            x = jnp.asarray(rng.normal(size=(2, h, h, 3)), jnp.float32)
+            w = jnp.asarray(rng.normal(size=(7, 7, 3, 8)), jnp.float32)
+            pads = spatial_padding((h, h), (7, 7), (2, 2), (0, 0), mode)
+            ref = lax.conv_general_dilated(
+                x, w, (2, 2), pads,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            got = conv2d_space_to_depth(x, w, padding=pads)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+            g_ref = jax.grad(lambda w: jnp.sum(lax.conv_general_dilated(
+                x, w, (2, 2), pads,
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) ** 2))(w)
+            g_got = jax.grad(lambda w: jnp.sum(
+                conv2d_space_to_depth(x, w, padding=pads) ** 2))(w)
+            np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_strided_1x1_slice_matches_direct(self):
+        import jax.numpy as jnp
+        from jax import lax
+        from deeplearning4j_tpu.ops.convolution import (
+            conv2d_strided_1x1_as_slice)
+        rng = np.random.default_rng(1)
+        for h in (56, 57):
+            x = jnp.asarray(rng.normal(size=(2, h, h, 16)), jnp.float32)
+            w = jnp.asarray(rng.normal(size=(1, 1, 16, 8)), jnp.float32)
+            ref = lax.conv_general_dilated(
+                x, w, (2, 2), [(0, 0), (0, 0)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            got = conv2d_strided_1x1_as_slice(x, w, strides=(2, 2))
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)

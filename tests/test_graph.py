@@ -465,3 +465,51 @@ def test_graph_rnn_time_step_multi_input_static_plus_sequence():
              for i in range(6)]
     np.testing.assert_allclose(full, np.stack(steps, axis=1),
                                rtol=1e-8, atol=1e-10)
+
+
+def test_selective_remat_exact_in_f32(monkeypatch):
+    """DL4J_TPU_REMAT drops tagged stage activations from the residual set
+    (jax.checkpoint save_anything_except_these_names); the recompute must
+    be mathematically invisible — identical score and post-step params in
+    f32 (PERF.md round 5: the large-batch memory lever)."""
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(11).updater(Sgd(0.05))
+                .dtype(DtypePolicy(param_dtype="float32",
+                                   compute_dtype="float32"))
+                .graph_builder()
+                .add_inputs("img")
+                .add_layer("s0b0_conv", Convolution2D(
+                    n_out=4, kernel=(3, 3), mode="same",
+                    activation="identity"), "img")
+                .add_layer("s0b0_bn", BatchNorm(activation="identity"),
+                           "s0b0_conv")
+                .add_vertex("s0b0_add", ElementWiseVertex(op="add"),
+                            "s0b0_bn", "img")
+                .add_layer("gp", GlobalPooling(pooling="avg"), "s0b0_add")
+                .add_layer("out", Output(n_out=3, activation="softmax",
+                                         loss="mcxent"), "gp")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(8, 8, 4))
+                .build())
+        return ComputationGraph(conf).init()
+
+    rng = np.random.default_rng(5)
+    mds = MultiDataSet(
+        [rng.normal(size=(4, 8, 8, 4)).astype(np.float32)],
+        [np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]])
+
+    monkeypatch.delenv("DL4J_TPU_REMAT", raising=False)
+    base = build()
+    s0 = float(base.fit_batch(mds))
+
+    monkeypatch.setenv("DL4J_TPU_REMAT", "s0b")
+    rem = build()
+    s1 = float(rem.fit_batch(mds))
+
+    assert s0 == s1
+    for ln in base.params:
+        for pn in base.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(base.params[ln][pn]),
+                np.asarray(rem.params[ln][pn]), err_msg=f"{ln}.{pn}")

@@ -203,3 +203,42 @@ class TestReviewRegressions:
         # train-mode inference works and uses fresh dropout masks each call
         assert o1.shape == (4, 2)
         assert not np.allclose(o1, o2)
+
+
+class TestParamAndGradientListener:
+    def test_logs_header_and_rows_with_update_columns(self, tmp_path):
+        import io
+
+        from deeplearning4j_tpu.optimize.listeners import (
+            ParamAndGradientIterationListener)
+
+        x, y = make_blobs(n=64)
+        net = MultiLayerNetwork(build_mlp()).init()
+        buf = io.StringIO()
+        net.set_listeners(ParamAndGradientIterationListener(
+            iterations=1, file=buf))
+        net.fit(x, y, epochs=1, batch_size=32)
+        lines = buf.getvalue().strip().splitlines()
+        header = lines[0].split("\t")
+        assert header[:2] == ["n", "score"]
+        # reference column suffixes: params then updates ("G" columns)
+        assert "layer_0_W_mean" in header
+        assert "layer_0_W_meanAbsValueG" in header
+        rows = [ln.split("\t") for ln in lines[1:]]
+        assert len(rows) == 2  # 64 examples / batch 32
+        for row in rows:
+            assert len(row) == len(header)
+            assert np.isfinite([float(v) for v in row]).all()
+        # update columns non-zero from the FIRST row (the epoch-start
+        # snapshot supplies the first delta's left edge) onward
+        col = header.index("layer_0_W_meanAbsValueG")
+        assert float(rows[0][col]) > 0.0
+        assert float(rows[1][col]) > 0.0
+        # sampled frequency: every 2nd iteration only
+        buf2 = io.StringIO()
+        net2 = MultiLayerNetwork(build_mlp()).init()
+        net2.set_listeners(ParamAndGradientIterationListener(
+            iterations=2, file=buf2))
+        net2.fit(x, y, epochs=2, batch_size=32)
+        rows2 = buf2.getvalue().strip().splitlines()[1:]
+        assert len(rows2) == 2  # 4 iterations total, every 2nd logged
