@@ -1,132 +1,101 @@
 """Self-contained HTML evaluation reports.
 
 Parity: deeplearning4j-core evaluation/EvaluationTools.java
-(exportRocChartsToHtmlFile / exportevaluationToHtmlFile) — the reference
-renders ROC + precision/recall charts and the confusion matrix through
-its UI component library; here the charts are inline SVG with zero
-external assets (works in zero-egress environments, same stance as
-ui/server.py)."""
+(exportRocChartsToHtmlFile / exportEvaluationToHtmlFile). The reference
+composes its reports from the deeplearning4j-ui-components library; this
+module does the same through ``ui/components.py`` (ChartLine for
+ROC/precision-recall, ComponentTable for the confusion matrix and metric
+tables, rendered to one standalone page with inline SVG — zero external
+assets, same stance as ui/server.py)."""
 
 from __future__ import annotations
 
-import html
-
 import numpy as np
 
-_STYLE = """
-body{font-family:system-ui,sans-serif;margin:18px;color:#222}
-h2{color:#1a237e} h3{margin:18px 0 6px;font-size:15px;color:#444}
-.row{display:flex;flex-wrap:wrap;gap:22px}
-svg{background:#fff;border:1px solid #ccc}
-table{border-collapse:collapse;font-size:13px;margin:8px 0}
-td,th{border:1px solid #ddd;padding:4px 9px;text-align:right}
-th{background:#f0f0f4}
-.diag{background:#e4efe4;font-weight:600}
-"""
+from deeplearning4j_tpu.ui.components import (ChartLine, ComponentDiv,
+                                              ComponentTable, ComponentText,
+                                              Style,
+                                              render_components_to_file)
+
+def _chart_style() -> Style:
+    """Per-chart style instance (Style is mutable — never share one)."""
+    return Style(width=380, height=380)
 
 
-def _svg_curve(xs, ys, *, title, xlabel, ylabel, diagonal=False,
-               size=360, pad=42):
-    """One framed SVG line chart on the unit square."""
-    s = size - 2 * pad
-
-    def X(v):
-        return pad + float(v) * s
-
-    def Y(v):
-        return size - pad - float(v) * s
-
-    pts = " ".join(f"{X(x):.1f},{Y(y):.1f}" for x, y in zip(xs, ys))
-    grid = "".join(
-        f'<line x1="{X(v)}" y1="{Y(0)}" x2="{X(v)}" y2="{Y(1)}" '
-        f'stroke="#eee"/>'
-        f'<line x1="{X(0)}" y1="{Y(v)}" x2="{X(1)}" y2="{Y(v)}" '
-        f'stroke="#eee"/>'
-        f'<text x="{X(v)}" y="{size - pad + 16}" font-size="10" '
-        f'text-anchor="middle">{v:.1f}</text>'
-        f'<text x="{pad - 8}" y="{Y(v) + 3}" font-size="10" '
-        f'text-anchor="end">{v:.1f}</text>'
-        for v in (0.0, 0.25, 0.5, 0.75, 1.0))
-    diag = (f'<line x1="{X(0)}" y1="{Y(0)}" x2="{X(1)}" y2="{Y(1)}" '
-            f'stroke="#bbb" stroke-dasharray="4"/>' if diagonal else "")
-    return f"""<svg width="{size}" height="{size}">
-<text x="{size / 2}" y="16" text-anchor="middle" font-size="13"
- font-weight="600">{html.escape(title)}</text>
-{grid}{diag}
-<rect x="{pad}" y="{pad}" width="{s}" height="{s}" fill="none"
- stroke="#999"/>
-<polyline points="{pts}" fill="none" stroke="#1a74bb" stroke-width="2"/>
-<text x="{size / 2}" y="{size - 6}" text-anchor="middle"
- font-size="11">{html.escape(xlabel)}</text>
-<text x="12" y="{size / 2}" font-size="11" text-anchor="middle"
- transform="rotate(-90 12 {size / 2})">{html.escape(ylabel)}</text>
-</svg>"""
+def _unit_chart(title, xlabel, ylabel, xs, ys, diagonal=False) -> ChartLine:
+    c = ChartLine(title, _chart_style(), xlabel=xlabel, ylabel=ylabel)
+    if diagonal:
+        c.add_series("chance", [0.0, 1.0], [0.0, 1.0])
+    c.add_series(title, list(map(float, xs)), list(map(float, ys)))
+    return c
 
 
-def roc_chart_html(roc, title: str = "ROC") -> str:
-    """ROC + precision/recall chart pair for one ``ROC`` accumulator."""
+def roc_components(roc, title: str = "ROC"):
+    """ROC + precision/recall chart pair for one ``ROC`` accumulator, as
+    UI components (the reference builds the same pair of ChartLine
+    components in EvaluationTools.rocChart)."""
     fpr, tpr = roc.get_roc_curve()
     order = np.argsort(fpr, kind="stable")
     rec, prec = roc.get_precision_recall_curve()
     ro = np.argsort(rec, kind="stable")
     auc = roc.calculate_auc()
-    return (f'<div class="row">'
-            + _svg_curve(fpr[order], tpr[order],
-                         title=f"{title} (AUC {auc:.4f})",
-                         xlabel="False positive rate",
-                         ylabel="True positive rate", diagonal=True)
-            + _svg_curve(rec[ro], prec[ro], title=f"{title} P-R",
-                         xlabel="Recall", ylabel="Precision")
-            + "</div>")
+    return ComponentDiv(
+        _unit_chart(f"{title} (AUC {auc:.4f})", "False positive rate",
+                    "True positive rate", fpr[order], tpr[order],
+                    diagonal=True),
+        _unit_chart(f"{title} P-R", "Recall", "Precision", rec[ro],
+                    prec[ro]))
+
+
+def roc_chart_html(roc, title: str = "ROC") -> str:
+    """Rendered HTML for one ROC chart pair (back-compat surface)."""
+    return roc_components(roc, title).render()
 
 
 def export_roc_charts_to_html_file(roc, path: str,
                                    title: str = "ROC evaluation"):
     """EvaluationTools.exportRocChartsToHtmlFile parity. ``roc`` is a
     ``ROC`` or a ``ROCMultiClass`` (one chart pair per class)."""
-    body = []
+    comps = []
     if hasattr(roc, "rocs"):  # ROCMultiClass / ROCBinary
         for i, r in enumerate(getattr(roc, "rocs")):
-            body.append(roc_chart_html(r, title=f"class {i}"))
+            comps.append(roc_components(r, title=f"class {i}"))
     else:
-        body.append(roc_chart_html(roc, title="ROC"))
-    _write_html(path, title, "\n".join(body))
+        comps.append(roc_components(roc, title="ROC"))
+    render_components_to_file(comps, path, title)
+
+
+def evaluation_components(ev, class_names=None):
+    """Confusion matrix + per-class metric tables for an ``Evaluation``,
+    as UI components."""
+    n = ev.num_classes
+    names = class_names or ev.class_names or [str(i) for i in range(n)]
+    conf_rows = [[str(names[i])]
+                 + [str(ev.confusion.get_count(i, j)) for j in range(n)]
+                 for i in range(n)]
+    conf = ComponentTable(
+        [""] + [str(c) for c in names], conf_rows,
+        title="Confusion matrix (rows = actual)",
+        highlight_cells=[(i, i + 1) for i in range(n)])
+    met_rows = [[str(names[c]), f"{ev.precision(c):.4f}",
+                 f"{ev.recall(c):.4f}", f"{ev.f1(c):.4f}"]
+                for c in range(n)]
+    mets = ComponentTable(["class", "precision", "recall", "f1"], met_rows,
+                          title="Per-class metrics")
+    summary = ComponentText(
+        f"accuracy {ev.accuracy():.4f} — macro-F1 {ev.f1():.4f}")
+    return [conf, mets, summary]
 
 
 def evaluation_html(ev, class_names=None) -> str:
-    """Confusion matrix + per-class metric table for an ``Evaluation``."""
-    n = ev.num_classes
-    names = class_names or ev.class_names or [str(i) for i in range(n)]
-    head = "".join(f"<th>{html.escape(str(c))}</th>" for c in names)
-    rows = []
-    for i in range(n):
-        cells = "".join(
-            f'<td class="{"diag" if i == j else ""}">'
-            f"{ev.confusion.get_count(i, j)}</td>" for j in range(n))
-        rows.append(f"<tr><th>{html.escape(str(names[i]))}</th>{cells}</tr>")
-    conf = (f"<h3>Confusion matrix (rows = actual)</h3>"
-            f"<table><tr><th></th>{head}</tr>{''.join(rows)}</table>")
-    met_rows = "".join(
-        f"<tr><th>{html.escape(str(names[c]))}</th>"
-        f"<td>{ev.precision(c):.4f}</td><td>{ev.recall(c):.4f}</td>"
-        f"<td>{ev.f1(c):.4f}</td></tr>" for c in range(n))
-    mets = (f"<h3>Per-class metrics</h3><table><tr><th>class</th>"
-            f"<th>precision</th><th>recall</th><th>f1</th></tr>"
-            f"{met_rows}</table>"
-            f"<p>accuracy {ev.accuracy():.4f} — macro-F1 {ev.f1():.4f}</p>")
-    return conf + mets
+    """Rendered HTML fragment (back-compat surface)."""
+    return "\n".join(c.render()
+                     for c in evaluation_components(ev, class_names))
 
 
 def export_evaluation_to_html_file(ev, path: str,
                                    title: str = "Classification evaluation",
                                    class_names=None):
     """EvaluationTools evaluation-report parity (confusion + metrics)."""
-    _write_html(path, title, evaluation_html(ev, class_names))
-
-
-def _write_html(path: str, title: str, body: str):
-    with open(path, "w") as f:
-        f.write(f"<!doctype html><html><head><meta charset='utf-8'>"
-                f"<title>{html.escape(title)}</title>"
-                f"<style>{_STYLE}</style></head><body>"
-                f"<h2>{html.escape(title)}</h2>{body}</body></html>")
+    render_components_to_file(evaluation_components(ev, class_names), path,
+                              title)

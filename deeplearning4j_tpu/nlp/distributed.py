@@ -48,6 +48,9 @@ class MultiProcessSequenceVectors:
     def __init__(self, vectors, shard: bool = True):
         self.vectors = vectors
         self.shard = shard
+        from deeplearning4j_tpu.parallel.stats import TrainingStatsCollector
+        self.stats = TrainingStatsCollector(
+            worker_id=f"worker_{jax.process_index()}")
 
     @property
     def process_index(self) -> int:
@@ -63,12 +66,13 @@ class MultiProcessSequenceVectors:
         return sequences[self.process_index::self.process_count]
 
     def average_now(self):
-        lt = self.vectors.lookup
-        lt.syn0 = _average_across_processes(lt.syn0)
-        if getattr(lt, "syn1", None) is not None:
-            lt.syn1 = _average_across_processes(lt.syn1)
-        if getattr(lt, "syn1neg", None) is not None:
-            lt.syn1neg = _average_across_processes(lt.syn1neg)
+        with self.stats.time_phase("average"):
+            lt = self.vectors.lookup
+            lt.syn0 = _average_across_processes(lt.syn0)
+            if getattr(lt, "syn1", None) is not None:
+                lt.syn1 = _average_across_processes(lt.syn1)
+            if getattr(lt, "syn1neg", None) is not None:
+                lt.syn1neg = _average_across_processes(lt.syn1neg)
         return self
 
     def fit(self, sequences: Iterable[List[str]]):
@@ -78,7 +82,8 @@ class MultiProcessSequenceVectors:
         sequences = list(sequences)
         v = self.vectors
         if v.vocab is None:
-            v.build_vocab(sequences)
+            with self.stats.time_phase("vocab"):
+                v.build_vocab(sequences)
         local = self._local_shard(sequences)
         epochs = v.config.epochs
         lr0 = v.config.learning_rate
@@ -90,8 +95,9 @@ class MultiProcessSequenceVectors:
         v.config.epochs = 1
         try:
             for e in range(epochs):
-                v.fit(local, lr_range=(lr0 * (1 - e / epochs),
-                                       lr0 * (1 - (e + 1) / epochs)))
+                with self.stats.time_phase("fit"):
+                    v.fit(local, lr_range=(lr0 * (1 - e / epochs),
+                                           lr0 * (1 - (e + 1) / epochs)))
                 if self.process_count > 1:
                     self.average_now()
         finally:

@@ -25,17 +25,19 @@ from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import BaseLayerConfig
 from deeplearning4j_tpu.nn.updater import apply_layer_updates
 
-_REMAT_TAG = "dl4j_remat"
-
-
 def _remat_prefixes() -> tuple:
     """Selective rematerialization scope: comma-separated vertex-name
-    prefixes (e.g. ``DL4J_TPU_REMAT=s0b`` drops every stage-1 block
-    activation from the saved residual set and recomputes them in the
-    backward). The TPU answer to activation-memory pressure at large
-    batch: trade cheap stage FLOPs for HBM residency (global remat was
-    measured unprofitable — PERF.md r3; this targets only the named
-    stages). Default off."""
+    prefixes (e.g. ``DL4J_TPU_REMAT=s0b`` recomputes every stage-1 block
+    interior in the backward instead of saving it). The TPU answer to
+    activation-memory pressure at large batch: trade cheap stage FLOPs
+    for HBM residency. Granularity is BLOCK-level: each maximal
+    contiguous topo run of matching vertices executes under one
+    jax.checkpoint, so only the span's INPUTS are saved and XLA keeps
+    full scheduling freedom elsewhere. (The alternative — wrapping the
+    whole loss in a jax.checkpoint name-policy — was measured NEGATIVE:
+    forcing every untagged intermediate into the explicit residual set
+    cost +18 GB/step and +3.8 GB peak on ResNet-50, PERF.md round 5.)
+    Default off."""
     import os
     v = os.environ.get("DL4J_TPU_REMAT", "").strip()
     return tuple(p for p in (s.strip() for s in v.split(",")) if p)
@@ -203,6 +205,94 @@ class ComputationGraph:
         if self.params is None:
             raise RuntimeError("Call init() before fit()/output()/evaluate()")
 
+    # -------------------------------------------------- selective remat
+    def _remat_spans(self, prefixes, skip: set) -> Dict[str, list]:
+        """Maximal contiguous topo runs of prefix-matching vertices,
+        keyed by first vertex. Excludes loss-bearing layers, vertices the
+        caller needs inputs of, and the named-input rnn vertices (their
+        mask wiring is not replicated inside a span)."""
+        from deeplearning4j_tpu.nn.conf.vertices import (
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex)
+        spans: Dict[str, list] = {}
+        run: list = []
+
+        def close():
+            if run:
+                spans[run[0]] = list(run)
+                run.clear()
+
+        for name in self.topo:
+            conf = self._resolved_confs[name]
+            layer = self._layer_by_name.get(name)
+            ok = (any(name.startswith(p) for p in prefixes)
+                  and name not in skip
+                  and not (layer is not None and hasattr(layer, "loss"))
+                  and not isinstance(conf, (LastTimeStepVertex,
+                                            DuplicateToTimeSeriesVertex)))
+            if ok:
+                run.append(name)
+            else:
+                close()
+        close()
+        return spans
+
+    def _span_ext_inputs(self, span: list) -> list:
+        span_set = set(span)
+        ext = []
+        for v in span:
+            for src in self.conf.vertex_inputs[v]:
+                if src not in span_set and src not in ext:
+                    ext.append(src)
+        return ext
+
+    def _run_remat_span(self, span, params, state, acts, masks, new_state,
+                        rng):
+        """Execute one contiguous vertex span under jax.checkpoint.
+        Mutates acts/masks/new_state; returns (advanced rng, span len)."""
+        span_set = set(span)
+        ext = {src: acts[src] for src in self._span_ext_inputs(span)}
+        # span outputs: vertices consumed outside the span (or network
+        # outputs); these are the only activations that leave the
+        # checkpoint boundary — everything interior is recomputed
+        consumed_outside = set(self.conf.network_outputs)
+        for v, ins in self.conf.vertex_inputs.items():
+            if v not in span_set:
+                consumed_outside.update(ins)
+        outs = [v for v in span if v in consumed_outside] or [span[-1]]
+        rngs = {}
+        if rng is not None:
+            for v in span:
+                if self.vertex_kind[v] == "layer":
+                    rng, lr = jax.random.split(rng)
+                    rngs[v] = lr
+        p_sub = {v: params[v] for v in span if v in params}
+        s_sub = {v: state[v] for v in span if v in state}
+
+        def run_span(p_sub, s_sub, ext, rngs):
+            local = dict(ext)
+            ns = {}
+            for v in span:
+                conf = self._resolved_confs[v]
+                xs = [local[i] for i in self.conf.vertex_inputs[v]]
+                if self.vertex_kind[v] == "layer":
+                    layer = self._layer_by_name[v]
+                    y, s_new = layer.apply(
+                        p_sub.get(v, {}), s_sub.get(v, {}), xs[0],
+                        train=True, rng=rngs.get(v), mask=None)
+                    if s_new:
+                        ns[v] = s_new
+                    local[v] = y
+                else:
+                    local[v] = conf.forward(*xs, masks=[None] * len(xs))
+            return {v: local[v] for v in outs}, ns
+
+        out_acts, ns = jax.checkpoint(run_span)(p_sub, s_sub, ext, rngs)
+        acts.update(out_acts)
+        for v in span:
+            masks[v] = None
+        new_state.update(ns)
+        return rng, len(span)
+
     # -------------------------------------------------------------- forward
     def _walk(self, params, state, inputs: Dict, *, train, rng,
               fmasks: Optional[Dict] = None, need_inputs_of=()):
@@ -212,15 +302,6 @@ class ComputationGraph:
         masks = dict(fmasks or {})
         saved_inputs = {}
         new_state = dict(state)
-        remat = _remat_prefixes() if train else ()
-
-        def _tag(name, y):
-            """Mark a vertex activation droppable under selective remat
-            (only has effect inside the jax.checkpoint-wrapped loss)."""
-            if remat and any(name.startswith(p) for p in remat):
-                from jax.ad_checkpoint import checkpoint_name
-                return checkpoint_name(y, _REMAT_TAG)
-            return y
         from deeplearning4j_tpu.nn.conf.vertices import (
             DuplicateToTimeSeriesVertex, LastTimeStepVertex)
         # training walks route matched bottleneck tails through the fused
@@ -230,7 +311,27 @@ class ComputationGraph:
         if not train:
             plans = {}
         interior = self._fusion_interior if plans else frozenset()
-        for name in self.topo:
+        # selective block remat: maximal contiguous topo runs of vertices
+        # matching DL4J_TPU_REMAT prefixes execute under one
+        # jax.checkpoint (span inputs saved, interiors recomputed in the
+        # backward). Plain path only: fusion plans and masked inputs
+        # fall back to inline execution.
+        remat = _remat_prefixes() if train else ()
+        spans = (self._remat_spans(remat, set(need_inputs_of))
+                 if remat and not plans else {})
+        topo_i = 0
+        topo = self.topo
+        while topo_i < len(topo):
+            name = topo[topo_i]
+            span = spans.get(name)
+            if span is not None and not any(
+                    masks.get(e) is not None
+                    for e in self._span_ext_inputs(span)):
+                rng, step = self._run_remat_span(
+                    span, params, state, acts, masks, new_state, rng)
+                topo_i += step
+                continue
+            topo_i += 1
             if name in interior:
                 continue
             if name in plans:
@@ -238,7 +339,7 @@ class ComputationGraph:
                 fb = plans[name]
                 y, bn_state_new = _fusion.execute_fused_tail(
                     fb, self, params, state, acts)
-                acts[name] = _tag(name, y)
+                acts[name] = y
                 masks[name] = None
                 new_state[fb.bn] = bn_state_new
                 continue
@@ -268,10 +369,10 @@ class ComputationGraph:
                                        mask=in_masks[0])
                 if s_new:
                     new_state[name] = s_new
-                acts[name] = _tag(name, y)
+                acts[name] = y
                 masks[name] = layer.feed_forward_mask(in_masks[0])
             else:
-                acts[name] = _tag(name, conf.forward(*xs, masks=in_masks))
+                acts[name] = conf.forward(*xs, masks=in_masks)
                 masks[name] = conf.feed_forward_mask(*in_masks)
         return acts, saved_inputs, masks, new_state
 
@@ -334,15 +435,6 @@ class ComputationGraph:
         def loss_fn(params, state, inputs, labels, fmasks, lmasks, rng):
             return self._loss(params, state, inputs, labels, fmasks, lmasks,
                               rng)
-
-        if _remat_prefixes():
-            # selective remat: save every residual EXCEPT the activations
-            # _walk tagged for the named stages; XLA recomputes those in
-            # the backward (activation-memory for stage FLOPs)
-            loss_fn = jax.checkpoint(
-                loss_fn,
-                policy=jax.checkpoint_policies.save_anything_except_these_names(
-                    _REMAT_TAG))
 
         def step_fn(params, state, opt_state, it, inputs, labels, fmasks,
                     lmasks, rng):

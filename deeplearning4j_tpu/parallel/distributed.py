@@ -112,6 +112,11 @@ class MultiProcessLocalSGD:
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
         self._local_steps = 0
+        # per-phase EventStats (ParameterAveragingTrainingMasterStats
+        # parity — parallel/stats.py): fit / average timings per worker
+        from deeplearning4j_tpu.parallel.stats import TrainingStatsCollector
+        self.stats = TrainingStatsCollector(
+            worker_id=f"worker_{jax.process_index()}")
 
     def _average_tree(self, tree):
         from jax.experimental import multihost_utils
@@ -130,9 +135,10 @@ class MultiProcessLocalSGD:
         processResults aggregate/divide step
         (ParameterAveragingTrainingMaster.java:851-877), as one DCN
         all-gather + mean instead of a driver round-trip."""
-        self.net.params = self._average_tree(self.net.params)
-        if self.average_updaters and self.net.opt_state is not None:
-            self.net.opt_state = self._average_tree(self.net.opt_state)
+        with self.stats.time_phase("average"):
+            self.net.params = self._average_tree(self.net.params)
+            if self.average_updaters and self.net.opt_state is not None:
+                self.net.opt_state = self._average_tree(self.net.opt_state)
         return self.net
 
     def fit_batch(self, ds):
@@ -141,7 +147,11 @@ class MultiProcessLocalSGD:
         fit_batch directly, every process must take the same number of
         steps or the allgather deadlocks. ``fit`` handles uneven local
         iterators itself."""
-        score = self.net.fit_batch(ds)
+        with self.stats.time_phase("fit"):
+            score = self.net.fit_batch(ds)
+            # the step is async-dispatched; pull the score so the timed
+            # span covers real device work, not queue submission
+            float(score)
         self._local_steps += 1
         if self._local_steps % self.averaging_frequency == 0:
             self.average_now()

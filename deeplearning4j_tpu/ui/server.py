@@ -73,6 +73,12 @@ select{margin-left:12px}
    <h3>Model flow</h3><svg id="flow" style="height:auto"></svg>
  </div>
 </div>
+<div class="row">
+ <div class="card" id="phasecard" style="display:none">
+   <h3>Phase timeline (per worker)</h3><svg id="phases"
+    style="height:auto"></svg><div id="phaselegend" class="label"></div>
+ </div>
+</div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -150,6 +156,53 @@ async function refresh(){
   renderHistogram(m);
   await refreshEmbedding(sess, m.embedding_version ?? null);
   await refreshFlow(sess, m.activation_stats || {});
+  await refreshPhases(sess);
+}
+async function refreshPhases(sess){
+  // per-worker training-phase lanes (the Spark timeline tier): the
+  // distributed trainers post EventStats as static info "phase_stats";
+  // the phase->color map rides in the payload (one canonical source,
+  // parallel/stats.py PHASE_COLORS)
+  const p = await (await fetch("/api/phases?session="+
+                   encodeURIComponent(sess))).json();
+  const PHASE_COLORS = p.colors || {};
+  const ws = Object.keys(p.workers || {}).sort();
+  const card = document.getElementById("phasecard");
+  if (!ws.length){ card.style.display = "none"; return; }
+  card.style.display = "";
+  const el = document.getElementById("phases");
+  const W = el.clientWidth || 760, LH = 30, P = 64, TP = 6;
+  const H = TP*2 + ws.length*LH + 16;
+  el.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  el.style.height = H + "px";
+  let tmax = 0;
+  ws.forEach(w=>p.workers[w].forEach(e=>{
+    tmax = Math.max(tmax, e.start + e.duration_ms/1000); }));
+  if (tmax <= 0) tmax = 1;
+  const sx = t=>P + (W - P - 10) * t / tmax;
+  let html = "";
+  const seen = new Set();
+  ws.forEach((w, i)=>{
+    const y = TP + i*LH;
+    html += `<text x="${P-6}" y="${y+LH/2+3}" font-size="10"`+
+      ` text-anchor="end">${esc(w)}</text>`;
+    p.workers[w].forEach(e=>{
+      seen.add(e.phase);
+      const x0 = sx(e.start), x1 = sx(e.start + e.duration_ms/1000);
+      html += `<rect x="${x0.toFixed(1)}" y="${y+3}"`+
+        ` width="${Math.max(x1-x0, 1).toFixed(1)}" height="${LH-8}"`+
+        ` fill="${PHASE_COLORS[e.phase]||"#7f7f7f"}" fill-opacity="0.85">`+
+        `<title>${esc(e.phase)} ${e.duration_ms.toFixed(1)} ms</title>`+
+        `</rect>`;
+    });
+  });
+  html += `<text x="${P}" y="${H-2}" font-size="10" fill="#888">0s</text>`+
+    `<text x="${W-40}" y="${H-2}" font-size="10" fill="#888">`+
+    `${tmax.toFixed(2)}s</text>`;
+  el.innerHTML = html;
+  document.getElementById("phaselegend").innerHTML =
+    Array.from(seen).map(ph=>`<span style="color:${
+      PHASE_COLORS[ph]||"#7f7f7f"}">&#9632; ${esc(ph)}</span>`).join(" &nbsp;");
 }
 let lastModel = null;
 function renderHistogram(m){
@@ -322,6 +375,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.embedding_payload(q.get("session", "")))
         elif url.path == "/api/flow":
             self._json(ui.flow_payload(q.get("session", "")))
+        elif url.path == "/api/phases":
+            self._json(ui.phases_payload(q.get("session", "")))
         else:
             self._json({"error": "not found"}, 404)
 
@@ -460,6 +515,22 @@ class UIServer:
                 if info and "model" in info:
                     return {"model": info["model"], "worker": wid}
         return {"model": None, "worker": None}
+
+    def phases_payload(self, session_id: str) -> dict:
+        """Per-worker phase EventStats for the timeline card (the Spark
+        timeline surface — ParameterAveragingTrainingMasterStats /
+        StatsUtils.exportStatsAsHtml; the distributed trainers post
+        ``phase_stats`` via TrainingStatsCollector.post_to)."""
+        from deeplearning4j_tpu.parallel.stats import PHASE_COLORS
+        workers = {}
+        for s in self.storages:
+            for wid in s.list_worker_ids_for_session(session_id):
+                info = s.get_static_info(session_id, wid)
+                if info and "phase_stats" in info:
+                    workers[wid] = info["phase_stats"]
+        # colors ride in the payload so the live dashboard and the
+        # exported timeline HTML stay on ONE canonical phase->color map
+        return {"workers": workers, "colors": PHASE_COLORS}
 
     def embedding_payload(self, session_id: str) -> dict:
         """Published 2-D embedding scatter for the session (the reference

@@ -59,7 +59,13 @@ class BaseStatsStorage:
     def put_static_info(self, session_id: str, worker_id: str,
                         info: dict) -> None:
         with self._lock:
-            self._static[session_id][worker_id] = dict(info)
+            # MERGE by key: independent producers share one worker slot
+            # (StatsListener posts {"model": ...}, the distributed
+            # trainers post {"phase_stats": ...}; replacement would make
+            # them clobber each other)
+            merged = dict(self._static[session_id].get(worker_id) or {})
+            merged.update(info)
+            self._static[session_id][worker_id] = merged
             self._persist_static(session_id, worker_id, info)
         self._notify(POST_STATIC, session_id, worker_id)
 
@@ -166,8 +172,14 @@ class FileStatsStorage(BaseStatsStorage):
                     self._updates[r.session_id].setdefault(
                         r.worker_id, []).append(r)
                 elif kind == "static":
-                    self._static[rec["session_id"]][rec["worker_id"]] = (
-                        rec["info"])
+                    # merge-by-key replay, matching put_static_info's
+                    # semantics — records are persisted PARTIAL (one
+                    # producer's keys each), so replacement would let the
+                    # last producer clobber the others on reload
+                    slot = self._static[rec["session_id"]]
+                    merged = dict(slot.get(rec["worker_id"]) or {})
+                    merged.update(rec["info"])
+                    slot[rec["worker_id"]] = merged
 
     def _write(self, rec: dict) -> None:
         if self._file is None:

@@ -48,7 +48,7 @@ def _net_kind(net) -> str:
     return "graph" if isinstance(net, ComputationGraph) else "multilayer"
 
 
-def save_checkpoint(net, path: str):
+def save_checkpoint(net, path: str, stats=None):
     """Write {config, params, state, opt_state, step, epoch} under
     ``path`` (a directory). In a multi-process runtime every process must
     call this (orbax coordinates the parallel shard writes).
@@ -58,7 +58,18 @@ def save_checkpoint(net, path: str):
     checkpoint or one missing meta.json (detected at restore). Write each
     periodic save to a FRESH step directory (``.../step_1000`` as in the
     module example) — overwriting one path in place cannot be made
-    crash-atomic across the two commits."""
+    crash-atomic across the two commits.
+
+    ``stats``: optional parallel.stats.TrainingStatsCollector — records
+    the whole save (shard writes + cross-process barrier) as a
+    ``checkpoint_barrier`` EventStats phase for the training timeline."""
+    if stats is not None:
+        with stats.time_phase("checkpoint_barrier"):
+            return _save_checkpoint_inner(net, path)
+    return _save_checkpoint_inner(net, path)
+
+
+def _save_checkpoint_inner(net, path: str):
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     tree = {"params": net.params, "state": net.state or {},

@@ -89,6 +89,16 @@ def main():
                                                    averaging_frequency=2)
         for _ in range(steps):
             trainer.fit_batch(ds)
+        # per-phase EventStats (the Spark timeline tier): gather across
+        # BOTH processes (collective) and export the timeline page
+        import json as _json
+        events = trainer.stats.gather_across_processes()
+        if pid == 0:
+            from deeplearning4j_tpu.parallel.stats import (
+                export_timeline_html)
+            export_timeline_html(events, out_path + ".timeline.html")
+            with open(out_path + ".phases.json", "w") as f:
+                _json.dump([e.to_dict() for e in events], f)
     elif mode == "localsgd_fit":
         # windowed-agreement fit over UNEVEN local iterators: process 0
         # holds 5 batches, process 1 holds 7 — fit must train exactly

@@ -128,6 +128,24 @@ def test_two_process_local_sgd_matches_simulation(tmp_path):
     for k in keys:
         np.testing.assert_array_equal(a[k], b[k], err_msg=k)
 
+    # per-phase EventStats gathered across BOTH workers (the Spark
+    # ParameterAveragingTrainingMasterStats tier): every worker reports
+    # fit and average phases, and the timeline export renders one lane
+    # per worker with phase bars
+    import json
+    with open(outs[0] + ".phases.json") as f:
+        events = json.load(f)
+    by_worker = {}
+    for e in events:
+        by_worker.setdefault(e["worker_id"], set()).add(e["phase"])
+        assert e["duration_ms"] >= 0.0
+    assert sorted(by_worker) == ["worker_0", "worker_1"]
+    for w, phases in by_worker.items():
+        assert {"fit", "average"} <= phases, (w, phases)
+    html = open(outs[0] + ".timeline.html").read()
+    assert "worker_0" in html and "worker_1" in html
+    assert html.count("<svg") == 1 and "fit" in html
+
     # in-process simulation of the same schedule
     sys.path.insert(0, _DIR)
     import importlib

@@ -47,7 +47,12 @@ def test_resume_continues_training_identically(tmp_path):
     a = _mln()
     for _ in range(4):
         a.fit_batch(ds)
-    save_checkpoint(a, str(tmp_path / "ck"))
+    # checkpoint-barrier phase recording (the Spark timeline tier)
+    from deeplearning4j_tpu.parallel.stats import TrainingStatsCollector
+    col = TrainingStatsCollector("worker_0")
+    save_checkpoint(a, str(tmp_path / "ck"), stats=col)
+    assert [e.phase for e in col.events] == ["checkpoint_barrier"]
+    assert col.events[0].duration_ms > 0
 
     b = restore_multi_layer_network(str(tmp_path / "ck"))
     assert b.iteration == a.iteration

@@ -530,3 +530,71 @@ def test_flow_view_model_topology():
         assert 'id="flow"' in page and "refreshFlow" in page
     finally:
         server.stop()
+
+
+def test_phase_stats_endpoint_and_static_info_merge():
+    """Per-phase EventStats (the Spark timeline tier): the collector posts
+    phase_stats as static info; /api/phases serves per-worker lanes; and
+    static-info MERGE keeps the flow model and phase stats coexisting
+    under one worker key."""
+    from deeplearning4j_tpu.parallel.stats import TrainingStatsCollector
+
+    st = InMemoryStatsStorage()
+    st.put_update(_report(iteration=0, score=1.0))
+    # model topology posted first (the StatsListener flow view)...
+    st.put_static_info("s1", "worker_0", {"model": {"layers": []}})
+    # ...then phase stats from the trainer's collector: must MERGE
+    col = TrainingStatsCollector("worker_0")
+    with col.time_phase("fit"):
+        pass
+    with col.time_phase("average"):
+        pass
+    col.post_to(st, session_id="s1")
+    info = st.get_static_info("s1", "worker_0")
+    assert "model" in info and "phase_stats" in info
+
+    col1 = TrainingStatsCollector("worker_1")
+    with col1.time_phase("fit"):
+        pass
+    col1.post_to(st, session_id="s1")
+
+    server = UIServer(port=0)
+    try:
+        server.attach(st)
+        with urllib.request.urlopen(
+                server.url.rstrip("/") + "/api/phases?session=s1",
+                timeout=5) as resp:
+            payload = json.loads(resp.read())
+        workers = payload["workers"]
+        assert sorted(workers) == ["worker_0", "worker_1"]
+        phases0 = {e["phase"] for e in workers["worker_0"]}
+        assert phases0 == {"fit", "average"}
+        assert all(e["duration_ms"] >= 0 for e in workers["worker_0"])
+        # the dashboard page carries the timeline card
+        with urllib.request.urlopen(server.url, timeout=5) as resp:
+            page = resp.read()
+        assert b"phasecard" in page and b"refreshPhases" in page
+    finally:
+        server.stop()
+
+
+def test_phase_timeline_component_and_summary():
+    from deeplearning4j_tpu.parallel.stats import (EventStats,
+                                                   export_timeline_html,
+                                                   summary_table,
+                                                   timeline_component)
+    events = [EventStats("worker_0", "fit", 0.0, 1200.0),
+              EventStats("worker_0", "average", 1.2, 300.0),
+              EventStats("worker_1", "fit", 0.0, 1100.0)]
+    chart = timeline_component(events)
+    svg = chart.render()
+    assert "worker_0" in svg and "worker_1" in svg
+    assert svg.count("<rect") >= 4  # 3 bars + frame
+    tbl = summary_table(events).render()
+    assert "fit (ms)" in tbl and "average (ms)" in tbl
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.html")
+        export_timeline_html(events, p)
+        html = open(p).read()
+        assert html.startswith("<!doctype html>") and "<svg" in html
