@@ -14,3 +14,9 @@ from deeplearning4j_tpu.nlp.vectorizers import (
     TfidfVectorizer,
 )
 from deeplearning4j_tpu.nlp.distributed import MultiProcessSequenceVectors
+from deeplearning4j_tpu.nlp.cjk import (
+    DictionarySegmenter,
+    DictionaryTokenizerFactory,
+    LatticeSegmenter,
+    MorphToken,
+)

@@ -42,19 +42,60 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from deeplearning4j_tpu.nlp.tokenization import (CJKCharTokenizerFactory,
                                                  DefaultTokenizerFactory)
 
-# Compact starter lexicon: Japanese particles/copulas + common nouns and
-# verbs — enough to segment everyday sentences sensibly; extend with
-# load_dictionary for real corpora.
-_BUILTIN_JA = (
+# Starter lexicon: Japanese particles/copulas + common vocabulary —
+# enough to segment everyday sentences sensibly; extend with
+# load_dictionary for real corpora (one word per line,
+# ``word[<TAB>cost[<TAB>pos]]``).
+_JA_NOUNS = (
     "私 僕 彼 彼女 猫 犬 鳥 魚 本 水 山 川 空 海 雨 雪 花 木 日本 東京 "
     "学校 先生 学生 友達 家族 電車 車 道 店 駅 会社 仕事 料理 写真 音楽 "
     "映画 言葉 名前 時間 今日 明日 昨日 今 朝 夜 昼 年 月 週 毎日 "
-    "は が を に で と も の へ から まで より だ です ます でした "
-    "した する して いる ある ない なかった れる られる せる たい "
-    "食べる 飲む 行く 来る 見る 聞く 話す 読む 書く 買う 売る 作る "
-    "好き 嫌い 大きい 小さい 新しい 古い 高い 安い 良い 悪い "
-    "とても すこし たくさん これ それ あれ ここ そこ どこ 何 誰 いつ"
+    "これ それ あれ ここ そこ どこ 何 誰 いつ "
+    "人 男 女 子供 手 足 目 耳 口 頭 心 体 声 顔 力 お金 紙 部屋 家 国 "
+    "町 村 世界 場所 物 事 話 問題 質問 答え 意味 理由 方法 結果 情報 "
+    "電話 手紙 番号 文字 文章 漢字 言語 英語 日本語 外国 旅行 買い物 "
+    "食事 パン 肉 野菜 果物 卵 牛乳 お茶 酒 天気 風 火 土 石 季節 "
+    "春 夏 秋 冬 色 赤 青 白 黒 緑 時計 週末 休み 病院 銀行 図書館 "
+    "公園 空港 橋 建物 窓 机 椅子 箱 袋 服 靴 帽子 眼鏡 傘 荷物 切符 "
+    "新聞 雑誌 辞書 地下鉄 バス 飛行機 船 自転車 歌 絵 遊び 運動 練習 "
+    "勉強 試験 授業 宿題 教室 鉛筆 ノート 意見 気持ち 気分 病気 薬 "
+    "医者 警察 火事 事故 地震 台風 戦争 平和 歴史 文化 社会 経済 政治 "
+    "法律 科学 技術 自然 動物 植物 言い方 考え方 みんな 全部 一部 最初 "
+    "最後 次 前 後ろ 上 下 中 外 右 左 隣 間 近く 遠く 今年 去年 来年 "
+    "今週 来週 先週 今月 来月 先月 午前 午後 半分 大学 高校 中学 小学校"
 ).split()
+_JA_VERBS = (
+    "食べる 飲む 行く 来る 見る 聞く 話す 読む 書く 買う 売る 作る "
+    "使う 持つ 待つ 会う 言う 思う 知る 分かる 出る 入る 乗る 降りる "
+    "歩く 走る 泳ぐ 飛ぶ 帰る 休む 働く 遊ぶ 学ぶ 教える 覚える "
+    "忘れる 始める 終わる 開ける 閉める 消す 置く 取る 送る 届く 着く "
+    "立つ 座る 寝る 起きる 死ぬ 生きる 住む 呼ぶ 答える 聞こえる "
+    "見える 考える 感じる 信じる 笑う 泣く 怒る 歌う 踊る 洗う 切る "
+    "貸す 借りる 返す 払う 探す 見つける 決める 選ぶ 変わる 変える "
+    "動く 止まる 止める 続く 続ける 助ける 手伝う 頼む 渡す 受ける "
+    "落ちる 落とす 上がる 下がる 登る 並ぶ 集まる 集める"
+).split()
+_JA_ADJS = (
+    "好き 嫌い 大きい 小さい 新しい 古い 高い 安い 良い 悪い "
+    "美しい 楽しい 嬉しい 悲しい 暑い 寒い 暖かい 涼しい 強い 弱い "
+    "早い 速い 遅い 近い 遠い 長い 短い 広い 狭い 重い 軽い 明るい "
+    "暗い 忙しい 簡単 難しい 易しい 便利 不便 静か 有名 大切 大事 "
+    "元気 親切 丁寧 綺麗 汚い 危ない 安全 白い 黒い 赤い 青い 若い "
+    "面白い つまらない 甘い 辛い 苦い 美味しい 痛い 眠い"
+).split()
+_JA_ADVS = (
+    "とても すこし たくさん もっと まだ もう ずっと きっと 多分 全然 "
+    "いつも 時々 たまに すぐ ゆっくり ちょっと かなり 本当に 特に "
+    "例えば でも しかし だから それで そして また"
+).split()
+_JA_PARTICLES = "は が を に で と も の へ から まで より や か ね よ".split()
+_JA_AUX = (
+    "だ です ます でした した する して いる ある ない なかった "
+    "れる られる せる たい ました ません だった でしょう だろう"
+).split()
+
+_BUILTIN_JA = (_JA_NOUNS + _JA_VERBS + _JA_ADJS + _JA_ADVS + _JA_PARTICLES
+               + _JA_AUX)
 
 
 class DictionarySegmenter:
@@ -197,20 +238,14 @@ _DEFAULT_CONNECTIONS: Dict[Tuple[str, str], float] = {
     ("aux", "EOS"): 0.0, ("adj", "EOS"): 0.1,
 }
 
-# POS tags for the builtin starter lexicon (the TokenInfoDictionary tier).
+# POS tags for the builtin starter lexicon (the TokenInfoDictionary tier),
+# derived from the per-POS word lists above (nouns are the default).
 _BUILTIN_POS: Dict[str, str] = {}
-for _w in "は が を に で と も の へ から まで より".split():
-    _BUILTIN_POS[_w] = "particle"
-for _w in ("だ です ます でした した する して いる ある ない なかった "
-           "れる られる せる たい").split():
-    _BUILTIN_POS[_w] = "aux"
-for _w in ("食べる 飲む 行く 来る 見る 聞く 話す 読む 書く 買う 売る "
-           "作る").split():
-    _BUILTIN_POS[_w] = "verb"
-for _w in "好き 嫌い 大きい 小さい 新しい 古い 高い 安い 良い 悪い".split():
-    _BUILTIN_POS[_w] = "adj"
-for _w in "とても すこし たくさん".split():
-    _BUILTIN_POS[_w] = "adv"
+for _pos, _words in (("particle", _JA_PARTICLES), ("aux", _JA_AUX),
+                     ("verb", _JA_VERBS), ("adj", _JA_ADJS),
+                     ("adv", _JA_ADVS)):
+    for _w in _words:
+        _BUILTIN_POS[_w] = _pos
 
 
 class LatticeSegmenter:
