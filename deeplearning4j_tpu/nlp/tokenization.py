@@ -212,6 +212,81 @@ class NGramTokenizerFactory(DefaultTokenizerFactory):
 # Sentence iterators (text/sentenceiterator/ parity)
 # ---------------------------------------------------------------------------
 
+def split_sentences(text: str) -> List[str]:
+    """Split raw text into sentences — the SentenceAnnotator tier
+    (deeplearning4j-nlp-uima/.../annotator/SentenceAnnotator.java wraps
+    the UIMA sentence detector; here a rule-based splitter covering
+    Latin terminators, CJK 。！？, and blank-line paragraph breaks).
+    Abbreviation-safe for single-letter initials ("J. Smith")."""
+    text = text.replace("\r\n", "\n").replace("\r", "\n")
+    out: List[str] = []
+    buf: List[str] = []
+    quote_split = False  # terminator seen, closing quote still pending
+
+    def flush():
+        s = "".join(buf).strip()
+        if s:
+            out.append(s)
+        buf.clear()
+
+    for i, ch in enumerate(text):
+        if ch == "\n":
+            # blank line = hard break; single newline = soft space
+            prev = text[i - 1] if i >= 1 else None
+            nxt = text[i + 1] if i + 1 < len(text) else None
+            quote_split = False
+            if prev == "\n" or nxt == "\n":
+                flush()
+            elif buf and buf[-1] != " ":
+                buf.append(" ")
+            continue
+        buf.append(ch)
+        if quote_split:
+            quote_split = False
+            if ch == '"':  # keep the closing quote with its sentence
+                flush()
+                continue
+        if ch in "。！？":
+            flush()
+        elif ch in ".!?":
+            nxt = text[i + 1] if i + 1 < len(text) else None
+            # "J. Smith": a period after a single capital is an initial
+            initial = (ch == "." and i >= 1 and text[i - 1].isupper()
+                       and (i < 2 or not text[i - 2].isalpha()))
+            if initial:
+                continue
+            if nxt is None or nxt in (" ", "\t", "\n"):
+                flush()
+            elif nxt == '"':
+                quote_split = True
+    flush()
+    return out
+
+
+class DocumentSentenceIterator:
+    """SentenceIterator over raw DOCUMENTS: each document is segmented by
+    ``split_sentences`` (UimaSentenceIterator.java parity — the reference
+    feeds documents through the UIMA sentence detector to get the
+    sentence stream Word2Vec consumes)."""
+
+    def __init__(self, documents: Iterable[str], splitter=split_sentences):
+        self._docs = list(documents)
+        self._splitter = splitter
+        self._pre: Optional[Callable[[str], str]] = None
+
+    def set_pre_processor(self, fn: Callable[[str], str]):
+        self._pre = fn
+        return self
+
+    def __iter__(self):
+        for doc in self._docs:
+            for s in self._splitter(doc):
+                yield self._pre(s) if self._pre is not None else s
+
+    def reset(self):
+        return self
+
+
 class CollectionSentenceIterator:
     """Iterate over an in-memory list of sentences
     (CollectionSentenceIterator.java parity)."""

@@ -311,3 +311,47 @@ class TestNode2Vec:
         g = Graph.from_edge_list([(0, 1)])
         with pytest.raises(ValueError):
             Node2VecWalkIterator(g, 10, p=0.0)
+
+
+class TestSentenceSplitter:
+    """SentenceAnnotator tier (deeplearning4j-nlp-uima
+    text/annotator/SentenceAnnotator.java): rule-based sentence
+    segmentation feeding the SentenceIterator pipeline."""
+
+    def test_latin_and_cjk_terminators(self):
+        from deeplearning4j_tpu.nlp.tokenization import split_sentences
+        assert split_sentences("Hello there. How are you? Fine!") == [
+            "Hello there.", "How are you?", "Fine!"]
+        assert split_sentences("私は猫が好き。彼は犬が好き！そうですか？") == [
+            "私は猫が好き。", "彼は犬が好き！", "そうですか？"]
+
+    def test_initials_and_decimals_not_split(self):
+        from deeplearning4j_tpu.nlp.tokenization import split_sentences
+        assert split_sentences("J. Smith wrote it. It is 3.14 long.") == [
+            "J. Smith wrote it.", "It is 3.14 long."]
+
+    def test_paragraph_breaks_and_soft_newlines(self):
+        from deeplearning4j_tpu.nlp.tokenization import split_sentences
+        out = split_sentences("line one\nline two\n\nnew paragraph")
+        assert out == ["line one line two", "new paragraph"]
+
+    def test_document_iterator_through_word2vec(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.nlp.tokenization import (
+            DocumentSentenceIterator)
+        docs = ["the cat sat here. the dog ran fast."] * 15
+        it = DocumentSentenceIterator(docs)
+        assert len(list(it)) == 30  # 2 sentences per document
+        w2v = Word2Vec(vector_size=8, window=2, epochs=2, negative=0,
+                       min_word_frequency=2, seed=3)
+        w2v.fit_sentences(it)
+        assert w2v.get_word_vector("cat") is not None
+
+    def test_crlf_is_a_soft_break_and_quotes_stay_attached(self):
+        from deeplearning4j_tpu.nlp.tokenization import split_sentences
+        # Windows line endings are soft wraps, not sentence breaks
+        assert split_sentences("line one\r\nline two") == [
+            "line one line two"]
+        # closing quote stays with the quoted sentence
+        assert split_sentences('He said "Stop!" Then he left.') == [
+            'He said "Stop!"', "Then he left."]
