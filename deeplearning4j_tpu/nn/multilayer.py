@@ -184,13 +184,80 @@ class MultiLayerNetwork:
         return self
 
     # -------------------------------------------------------------- forward
+    def _remat_spans(self, n: int) -> dict:
+        """start index -> end index for maximal contiguous runs of layers
+        whose names match the DL4J_TPU_REMAT prefixes (the chain-network
+        rendering of ComputationGraph's block-granular selective remat —
+        e.g. ``DL4J_TPU_REMAT=layer_`` remats every hidden layer, the
+        long-sequence memory lever for stacked LSTMs)."""
+        from deeplearning4j_tpu.nn.graph import _remat_prefixes
+        prefixes = _remat_prefixes()
+        spans = {}
+        if not prefixes:
+            return spans
+        start = None
+        for i in range(n):
+            ok = (any(self.layers[i].name.startswith(p) for p in prefixes)
+                  and not hasattr(self.layers[i], "loss"))
+            if ok and start is None:
+                start = i
+            elif not ok and start is not None:
+                if i - start >= 1:
+                    spans[start] = i
+                start = None
+        if start is not None and n - start >= 1:
+            spans[start] = n
+        return spans
+
+    def _run_remat_span(self, i, end, params, state, x, fmask, rng, train):
+        """Execute layers [i, end) under one jax.checkpoint: only the
+        span's inputs are saved; interiors (e.g. an LSTM's per-timestep
+        gate activations) are recomputed in the backward."""
+        rngs = []
+        for _ in range(i, end):
+            lr = None
+            if rng is not None:
+                rng, lr = jax.random.split(rng)
+            rngs.append(lr)
+        sub = self.layers[i:end]
+        p_sub = {ly.name: params.get(ly.name, {}) for ly in sub}
+        s_sub = {ly.name: state.get(ly.name, {}) for ly in sub}
+
+        def run_span(p_sub, s_sub, x, fmask, rngs):
+            ns = {}
+            for k, j in enumerate(range(i, end)):
+                ly = self.layers[j]
+                if self.preprocessors[j] is not None:
+                    x = self.preprocessors[j](x)
+                x, s_new = ly.apply(p_sub.get(ly.name, {}),
+                                    s_sub.get(ly.name, {}), x, train=train,
+                                    rng=rngs[k], mask=fmask)
+                fmask = ly.feed_forward_mask(fmask)
+                if s_new:
+                    ns[ly.name] = s_new
+            return x, fmask, ns
+
+        return jax.checkpoint(run_span)(p_sub, s_sub, x, fmask, tuple(rngs)
+                                        ), rng
+
     def _forward(self, params, state, x, *, train, rng, fmask=None,
                  to_layer: Optional[int] = None, collect=False):
         """Walk the stack; returns (final activation or list, new_state)."""
         acts = []
         new_state = dict(state)
         n = len(self.layers) if to_layer is None else to_layer
-        for i in range(n):
+        # selective remat spans apply on plain training walks only
+        # (collect needs every activation; eval has no backward)
+        spans = self._remat_spans(n) if train and not collect else {}
+        i = 0
+        while i < n:
+            end = spans.get(i)
+            if end is not None:
+                (x, fmask, ns), rng = self._run_remat_span(
+                    i, end, params, state, x, fmask, rng, train)
+                new_state.update(ns)
+                i = end
+                continue
             layer = self.layers[i]
             if self.preprocessors[i] is not None:
                 x = self.preprocessors[i](x)
@@ -205,6 +272,7 @@ class MultiLayerNetwork:
                 new_state[layer.name] = s_new
             if collect:
                 acts.append(x)
+            i += 1
         return (acts if collect else x), new_state
 
     def _loss(self, params, state, x, labels, fmask, lmask, rng, train=True):

@@ -242,3 +242,41 @@ class TestParamAndGradientListener:
         net2.fit(x, y, epochs=2, batch_size=32)
         rows2 = buf2.getvalue().strip().splitlines()[1:]
         assert len(rows2) == 2  # 4 iterations total, every 2nd logged
+
+
+def test_mln_selective_remat_exact_in_f32(monkeypatch):
+    """DL4J_TPU_REMAT on a chain network: contiguous matching layers run
+    under one jax.checkpoint — identical score and post-step params in
+    f32 (the long-sequence memory lever on the MLN path)."""
+    from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(9).updater(Sgd(0.05))
+                .dtype(DtypePolicy(param_dtype="float32",
+                                   compute_dtype="float32"))
+                .list()
+                .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+                .layer(Dense(n_out=16, activation="tanh"))
+                .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 12)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+
+    monkeypatch.delenv("DL4J_TPU_REMAT", raising=False)
+    base = build()
+    s0 = float(base.fit_batch(DataSet(x, y)))
+
+    monkeypatch.setenv("DL4J_TPU_REMAT", "layer_")
+    rem = build()
+    s1 = float(rem.fit_batch(DataSet(x, y)))
+
+    assert s0 == s1
+    for ln in base.params:
+        for pn in base.params[ln]:
+            np.testing.assert_array_equal(
+                np.asarray(base.params[ln][pn]),
+                np.asarray(rem.params[ln][pn]), err_msg=f"{ln}.{pn}")
