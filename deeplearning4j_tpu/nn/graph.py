@@ -170,16 +170,26 @@ class ComputationGraph:
         self.listeners = list(listeners)
         return self
 
-    def use_mesh(self, mesh, data_axis: str = "data"):
-        """Data-parallel sharding over a Mesh (see parallel/)."""
-        from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
+    def use_mesh(self, mesh, data_axis: str = "data",
+                 model_axis: str | None = None, tp_rules=None):
+        """Sharded training over a Mesh: data-parallel by default;
+        ``model_axis`` additionally shards weights column-parallel over
+        that axis (dp x tp — see parallel/tensor.py)."""
         self._mesh = (mesh, data_axis)
+        self._tp = (model_axis, tp_rules)  # survives re-placement paths
         self._train_step = None
         self._tbptt_step = None
         self._multi_steps = {}
         self._apply_fns = {}
         self._rnn_state = None
-        apply_mesh(self, mesh, data_axis)
+        if model_axis is not None:
+            from deeplearning4j_tpu.parallel.tensor import (
+                apply_tensor_parallel)
+            apply_tensor_parallel(self, mesh, data_axis, model_axis,
+                                  tp_rules)
+        else:
+            from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
+            apply_mesh(self, mesh, data_axis)
         return self
 
 

@@ -170,17 +170,30 @@ class MultiLayerNetwork:
         self.listeners.append(listener)
         return self
 
-    def use_mesh(self, mesh, data_axis: str = "data"):
-        """Shard training over a jax Mesh: batches split on ``data_axis``,
-        params replicated; XLA inserts the gradient all-reduce over ICI.
-        (Replaces ParallelWrapper/Spark parameter averaging — SURVEY.md §2.8.)"""
-        from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
+    def use_mesh(self, mesh, data_axis: str = "data",
+                 model_axis: str | None = None, tp_rules=None):
+        """Shard training over a jax Mesh: batches split on ``data_axis``;
+        params replicated (pure dp) or, with ``model_axis`` set, sharded
+        column-parallel over that axis (dp x tp — parallel/tensor.py).
+        XLA inserts every collective (gradient all-reduce over data,
+        activation all-gathers/reduce-scatters over model) in the one
+        compiled step. (Replaces ParallelWrapper/Spark parameter
+        averaging — SURVEY.md §2.8 — and adds the model-parallel axis the
+        reference never had.)"""
         self._mesh = (mesh, data_axis)
+        self._tp = (model_axis, tp_rules)  # survives re-placement paths
         self._train_step = None
         self._tbptt_step = None
         self._multi_steps = {}
         self._apply_fns = {}
-        apply_mesh(self, mesh, data_axis)
+        if model_axis is not None:
+            from deeplearning4j_tpu.parallel.tensor import (
+                apply_tensor_parallel)
+            apply_tensor_parallel(self, mesh, data_axis, model_axis,
+                                  tp_rules)
+        else:
+            from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
+            apply_mesh(self, mesh, data_axis)
         return self
 
     # -------------------------------------------------------------- forward

@@ -98,7 +98,8 @@ def _save_checkpoint_inner(net, path: str):
     return path
 
 
-def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data"):
+def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data",
+             model_axis=None, tp_rules=None):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -148,15 +149,23 @@ def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data"):
     net.iteration = int(meta["iteration"])
     net.epoch = int(meta["epoch"])
     if mesh is not None:
-        net.use_mesh(mesh, data_axis)
+        # model_axis/tp_rules must ride through or a dp x tp net silently
+        # resumes fully replicated (and may not even fit)
+        net.use_mesh(mesh, data_axis, model_axis=model_axis,
+                     tp_rules=tp_rules)
     return net
 
 
-def restore_multi_layer_network(path: str, mesh=None, data_axis="data"):
-    """Resume a sequential net (+ optionally place it on ``mesh``)."""
-    return _restore(path, "multilayer", mesh, data_axis)
+def restore_multi_layer_network(path: str, mesh=None, data_axis="data",
+                                model_axis=None, tp_rules=None):
+    """Resume a sequential net (+ optionally place it on ``mesh``;
+    ``model_axis``/``tp_rules`` restore a tensor-parallel placement)."""
+    return _restore(path, "multilayer", mesh, data_axis, model_axis,
+                    tp_rules)
 
 
-def restore_computation_graph(path: str, mesh=None, data_axis="data"):
-    """Resume a DAG net (+ optionally place it on ``mesh``)."""
-    return _restore(path, "graph", mesh, data_axis)
+def restore_computation_graph(path: str, mesh=None, data_axis="data",
+                              model_axis=None, tp_rules=None):
+    """Resume a DAG net (+ optionally place it on ``mesh``;
+    ``model_axis``/``tp_rules`` restore a tensor-parallel placement)."""
+    return _restore(path, "graph", mesh, data_axis, model_axis, tp_rules)
