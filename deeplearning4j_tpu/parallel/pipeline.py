@@ -1,0 +1,131 @@
+"""Pipeline (stage) parallelism over a ``pipe`` mesh axis.
+
+The reference's scaleout tier replicates the whole model on every worker
+(SURVEY.md §2.8); a pipeline axis is the TPU-native way to train models
+DEEPER than one device's HBM: each device holds ONE stage's parameters
+(the stage dim of a stacked param tree is sharded over ``pipe``), and
+microbatches stream through the device ring in a GPipe wavefront —
+``lax.ppermute`` hands each stage's activation to the next stage every
+tick, so after the S-1-tick fill the ring computes S microbatches
+concurrently. Built on ``shard_map`` like parallel/sequence.py, and
+fully differentiable: reverse-mode AD through the scan + ppermute yields
+the backward pipeline (cotangents ride the ring in reverse), so one
+``jax.grad`` of a loss on the pipeline output trains all stages.
+
+Scope: homogeneous repeated stages (stacked params with a leading stage
+dim — the transformer-block/repeated-MLP regime where pipeline
+parallelism is used in practice). Heterogeneous stems/heads run outside
+the pipelined trunk, dp/tp-style.
+
+Memory: each device stores its own stage's params + per-tick
+activations; the bubble is the standard GPipe (S-1)/(M+S-1) fraction —
+use n_micro >= 4*stages to amortize.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params):
+    """[{param: array}, ...] (one per stage, identical structure) ->
+    stacked pytree with a leading stage dim (shard THIS over 'pipe')."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def shard_stages(mesh: Mesh, pipe_axis: str, stacked_params):
+    """Place stacked stage params with the stage dim over ``pipe_axis``
+    (each device holds exactly its stage's slice)."""
+    def put(leaf):
+        spec = P(pipe_axis, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, stacked_params)
+
+
+def split_microbatches(x, n_micro: int):
+    """[B, ...] -> [n_micro, B/n_micro, ...] (the GPipe microbatch dim)."""
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    return x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+
+def pipeline_forward(mesh: Mesh, pipe_axis: str, stage_params, x_micro,
+                     stage_fn):
+    """GPipe forward: ``stage_params`` stacked with leading stage dim
+    sharded over ``pipe_axis`` (see ``shard_stages``); ``x_micro``
+    ``[M, mb, F]`` microbatched input (replicated); ``stage_fn(params,
+    x) -> y`` one stage's computation with matching in/out feature shape.
+    Returns ``[M, mb, F]`` outputs (replicated). Differentiable.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    stage_dims = {leaf.shape[0]
+                  for leaf in jax.tree_util.tree_leaves(stage_params)}
+    if stage_dims != {n_stages}:
+        # a multiple would shard 2+ stages per device and per_device
+        # would silently apply only the first — hard error instead
+        raise ValueError(
+            f"stacked stage dim(s) {sorted(stage_dims)} must equal the "
+            f"'{pipe_axis}' mesh axis size ({n_stages}): one stage per "
+            f"device")
+
+    def per_device(p_local, x_all):
+        s = jax.lax.axis_index(pipe_axis)
+        p = jax.tree_util.tree_map(lambda a: a[0], p_local)
+        m = x_all.shape[0]
+        ticks = m + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        recv0 = jnp.zeros_like(x_all[0])
+        out0 = jnp.zeros_like(x_all)
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            # stage 0 injects microbatch t (clamped; invalid ticks
+            # compute garbage that is never collected)
+            inj = x_all[jnp.clip(t, 0, m - 1)]
+            inp = jnp.where(s == 0, inj, recv)
+            y = stage_fn(p, inp)
+            out_idx = t - (n_stages - 1)
+            valid = (s == n_stages - 1) & (out_idx >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outbuf, y, jnp.clip(out_idx, 0, m - 1), 0)
+            outbuf = jnp.where(valid, updated, outbuf)
+            send = jax.lax.ppermute(y, pipe_axis, perm)
+            return (send, outbuf), None
+
+        (_, outbuf), _ = jax.lax.scan(tick, (recv0, out0),
+                                      jnp.arange(ticks))
+        # only the last stage wrote real outputs (others kept zeros):
+        # psum broadcasts the result to every device
+        return jax.lax.psum(outbuf, pipe_axis)
+
+    spec_p = jax.tree_util.tree_map(
+        lambda a: P(pipe_axis, *([None] * (a.ndim - 1))), stage_params)
+    return jax.shard_map(
+        per_device, mesh=mesh, in_specs=(spec_p, P()), out_specs=P(),
+        check_vma=False)(stage_params, x_micro)
+
+
+def pipeline_train_step(mesh: Mesh, pipe_axis: str, stage_fn, loss_fn,
+                        lr: float = 0.1):
+    """A jittable SGD step over a pipelined trunk: ``loss_fn(y, labels)``
+    is applied to the pipeline output (mean over microbatches folded in
+    by the caller's loss). Returns ``step(stage_params, x_micro,
+    labels_micro) -> (new_params, loss)``. The backward pipeline falls
+    out of reverse-mode AD through the forward schedule."""
+
+    def objective(params, x_micro, labels_micro):
+        y = pipeline_forward(mesh, pipe_axis, params, x_micro, stage_fn)
+        return loss_fn(y, labels_micro)
+
+    def step(params, x_micro, labels_micro):
+        loss, grads = jax.value_and_grad(objective)(params, x_micro,
+                                                    labels_micro)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return new_params, loss
+
+    return step
