@@ -25,10 +25,24 @@ from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import BaseLayerConfig
 from deeplearning4j_tpu.nn.updater import apply_layer_updates
 
+def _remat_match(name: str, prefixes) -> bool:
+    """Prefix match; a trailing ``$`` anchors an EXACT name (needed for
+    numeric layer names where 'layer_1' would also match 'layer_1x')."""
+    for p in prefixes:
+        if p.endswith("$"):
+            if name == p[:-1]:
+                return True
+        elif name.startswith(p):
+            return True
+    return False
+
+
 def _remat_prefixes() -> tuple:
     """Selective rematerialization scope: comma-separated vertex-name
     prefixes (e.g. ``DL4J_TPU_REMAT=s0b`` recomputes every stage-1 block
-    interior in the backward instead of saving it). The TPU answer to
+    interior in the backward instead of saving it; a trailing ``$``
+    anchors an exact vertex/layer name — ``layer_1$`` does not match
+    ``layer_10``). The TPU answer to
     activation-memory pressure at large batch: trade cheap stage FLOPs
     for HBM residency. Granularity is BLOCK-level: each maximal
     contiguous topo run of matching vertices executes under one
@@ -234,7 +248,7 @@ class ComputationGraph:
         for name in self.topo:
             conf = self._resolved_confs[name]
             layer = self._layer_by_name.get(name)
-            ok = (any(name.startswith(p) for p in prefixes)
+            ok = (_remat_match(name, prefixes)
                   and name not in skip
                   and not (layer is not None and hasattr(layer, "loss"))
                   and not isinstance(conf, (LastTimeStepVertex,

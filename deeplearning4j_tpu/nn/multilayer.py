@@ -203,14 +203,15 @@ class MultiLayerNetwork:
         rendering of ComputationGraph's block-granular selective remat —
         e.g. ``DL4J_TPU_REMAT=layer_`` remats every hidden layer, the
         long-sequence memory lever for stacked LSTMs)."""
-        from deeplearning4j_tpu.nn.graph import _remat_prefixes
+        from deeplearning4j_tpu.nn.graph import (_remat_match,
+                                                  _remat_prefixes)
         prefixes = _remat_prefixes()
         spans = {}
         if not prefixes:
             return spans
         start = None
         for i in range(n):
-            ok = (any(self.layers[i].name.startswith(p) for p in prefixes)
+            ok = (_remat_match(self.layers[i].name, prefixes)
                   and not hasattr(self.layers[i], "loss"))
             if ok and start is None:
                 start = i

@@ -280,3 +280,15 @@ def test_mln_selective_remat_exact_in_f32(monkeypatch):
             np.testing.assert_array_equal(
                 np.asarray(base.params[ln][pn]),
                 np.asarray(rem.params[ln][pn]), err_msg=f"{ln}.{pn}")
+
+
+def test_remat_match_anchors_exact_names():
+    """'layer_1$' must match layer_1 exactly and NOT layer_10 (the
+    numeric-name ambiguity the anchor exists for); plain prefixes stay
+    prefixes."""
+    from deeplearning4j_tpu.nn.graph import _remat_match
+    assert _remat_match("layer_1", ("layer_1$",))
+    assert not _remat_match("layer_10", ("layer_1$",))
+    assert _remat_match("layer_10", ("layer_1",))  # plain prefix
+    assert _remat_match("s0b0_conv", ("s0b",))
+    assert not _remat_match("s1b0_conv", ("s0b",))
