@@ -220,3 +220,32 @@ class TestTensorParallel:
         assert tuple(m.sharding.spec) == ()
         m1 = net.opt_state["layer_1"]["v"]["W"]
         assert tuple(m1.sharding.spec) == (None, "model")
+
+    def test_tp_computation_graph_conv_matches_single_device(self):
+        """dp x tp on the DAG path: conv channel dims sharded over
+        'model', BN batch stats partitioned by GSPMD — one f32 ResNet-18
+        step must match the single-device step."""
+        import jax
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.zoo import resnet18
+        from deeplearning4j_tpu.zoo.models import F32
+        mesh = self._mesh2d()
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+        mds = MultiDataSet([x], [y])
+        tp = resnet18(seed=11, dtype=F32).use_mesh(mesh,
+                                                   model_axis="model")
+        # a conv with 64 output channels shards over the 4-way model axis
+        spec = tuple(tp.params["stem_conv"]["W"].sharding.spec)
+        assert spec[-1] == "model", spec
+        s_tp = float(tp.fit_batch(mds))
+        single = resnet18(seed=11, dtype=F32)
+        s_one = float(single.fit_batch(mds))
+        assert abs(s_tp - s_one) < 1e-4, (s_tp, s_one)
+        for ln in single.params:
+            for pn in single.params[ln]:
+                np.testing.assert_allclose(
+                    np.asarray(jax.device_get(tp.params[ln][pn])),
+                    np.asarray(single.params[ln][pn]),
+                    rtol=1e-4, atol=1e-4, err_msg=f"{ln}.{pn}")
