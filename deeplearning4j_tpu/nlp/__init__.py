@@ -17,6 +17,7 @@ from deeplearning4j_tpu.nlp.distributed import MultiProcessSequenceVectors
 from deeplearning4j_tpu.nlp.cjk import (
     DictionarySegmenter,
     DictionaryTokenizerFactory,
+    KoreanTokenizerFactory,
     LatticeSegmenter,
     MorphToken,
 )

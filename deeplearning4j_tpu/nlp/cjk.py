@@ -524,3 +524,96 @@ def mecab_tokenizer_factory(dicdir: Optional[str] = None):
             return _T()
 
     return _MecabFactory()
+
+
+# Korean particles (josa), longest-match-first — the twitter-korean-text
+# stem/particle separation tier (deeplearning4j-nlp-korean/.../
+# KoreanTokenizer.java wraps TwitterKoreanProcessorJava.tokenize, whose
+# visible effect at this tier is splitting an eojeol into stem + josa)
+_KO_JOSA = sorted(
+    ("은 는 이 가 을 를 에 에서 에게 께 께서 와 과 도 만 의 로 으로 "
+     "부터 까지 보다 처럼 마다 조차 밖에 라고 이라고 하고 이나 나 "
+     "든지 라도 이라도 요 이며 며 랑 이랑").split(),
+    key=len, reverse=True)
+
+# Common-noun mini-lexicon validating stems before a SINGLE-syllable josa
+# is stripped: many Korean nouns END in josa-lookalike syllables
+# (고양이, 바나나), so suffix-only stripping would tokenize the same
+# word differently bare vs particle-marked and split its embedding mass.
+_KO_NOUNS = frozenset(
+    ("고양이 강아지 개 새 물 우유 밥 사람 남자 여자 아이 학생 선생님 "
+     "친구 가족 집 학교 회사 병원 도서관 공원 역 차 버스 기차 비행기 "
+     "자전거 길 나라 한국 서울 일본 중국 미국 영어 한국어 일본어 말 "
+     "글 책 신문 영화 음악 노래 사진 시간 오늘 내일 어제 아침 점심 "
+     "저녁 밤 봄 여름 가을 겨울 날씨 비 눈 바람 하늘 바다 산 강 꽃 "
+     "나무 색 돈 문 창문 책상 의자 옷 신발 모자 안경 우산 가방 전화 "
+     "컴퓨터 커피 빵 고기 생선 야채 과일 계란 물건 일 이름 문제 질문 "
+     "대답 뜻 이유 방법 결과 정보 이야기 마음 몸 손 발 귀 입 머리 "
+     "얼굴 목소리 힘 바나나").split())
+
+
+def _is_hangul(ch: str) -> bool:
+    return 0xAC00 <= ord(ch) <= 0xD7A3
+
+
+class KoreanTokenizerFactory(DefaultTokenizerFactory):
+    """Korean eojeol tokenizer: whitespace-split, then each Hangul
+    eojeol is separated into stem + trailing particle (josa) — the
+    deeplearning4j-nlp-korean tier (KoreanTokenizer.java). This is the
+    rule-based slice of what twitter-korean-text does; full
+    morphological analysis plugs in through ``mecab_tokenizer_factory``
+    (mecab-ko) exactly like the Japanese add-on path.
+
+    Split policy (consistency beats recall): a single-syllable josa is
+    stripped only when the remaining stem is a KNOWN noun (builtin
+    mini-lexicon + ``add_noun``/``nouns=``) — otherwise 고양이 would
+    tokenize as 고양+이 bare but 고양이 when particle-marked, splitting
+    one word's embedding mass; multi-syllable josa (에서, 부터, ...)
+    are rarely noun-final and strip from unknown stems too.
+
+    ``emit_josa=False`` drops the particles (the common Word2Vec
+    preprocessing — content words only)."""
+
+    _STRIP = "。、，．！？!?\"'()[]{}.,;:«»\u201c\u201d\u2018\u2019"
+
+    def __init__(self, emit_josa: bool = True, nouns=None):
+        super().__init__()
+        self.emit_josa = emit_josa
+        self._nouns = set(_KO_NOUNS if nouns is None else nouns)
+
+    def add_noun(self, word: str) -> "KoreanTokenizerFactory":
+        self._nouns.add(word)
+        return self
+
+    def _split_eojeol(self, word: str) -> List[str]:
+        if len(word) >= 2 and all(_is_hangul(c) for c in word):
+            if word in self._nouns:
+                return [word]  # a known bare noun is never split
+            for josa in _KO_JOSA:
+                if len(word) > len(josa) and word.endswith(josa):
+                    stem = word[: -len(josa)]
+                    if len(josa) >= 2 or stem in self._nouns:
+                        parts = [stem]
+                        if self.emit_josa:
+                            parts.append(josa)
+                        return parts
+        return [word]
+
+    def create(self, text: str):
+        raw: List[str] = []
+        for w in text.split():
+            w = w.strip(self._STRIP)
+            if w:
+                raw.extend(self._split_eojeol(w))
+        pre = self._pre
+
+        class _T:
+            def get_tokens(self_inner):
+                out = []
+                for t in raw:
+                    if pre is not None:
+                        t = pre.pre_process(t)
+                    if t:
+                        out.append(t)
+                return out
+        return _T()

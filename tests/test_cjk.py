@@ -256,3 +256,60 @@ class TestPosFilterAndStemmer:
         assert w2v.get_word_vector("run") is not None
         with pytest.raises(KeyError):
             w2v.get_word_vector("cats")
+
+
+class TestKoreanTokenizer:
+    """deeplearning4j-nlp-korean tier: eojeol -> stem + josa separation
+    (KoreanTokenizer.java wraps twitter-korean-text; here the rule-based
+    longest-match slice, mecab-ko via the plug-in path)."""
+
+    def test_josa_split(self):
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+        tf = KoreanTokenizerFactory()
+        assert tf.create("고양이는 우유를 마신다").get_tokens() == [
+            "고양이", "는", "우유", "를", "마신다"]
+        # longest match: 에서 beats 에
+        assert tf.create("학교에서 공부한다").get_tokens() == [
+            "학교", "에서", "공부한다"]
+
+    def test_drop_josa_mode_and_short_words_kept(self):
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+        tf = KoreanTokenizerFactory(emit_josa=False)
+        assert tf.create("고양이는 물을 마신다").get_tokens() == [
+            "고양이", "물", "마신다"]
+        # a bare single-syllable word is never mistaken for a particle
+        assert tf.create("나 는 간다").get_tokens() == ["나", "는", "간다"]
+
+    def test_through_word2vec(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CollectionSentenceIterator)
+        sents = ["고양이는 우유를 마신다", "강아지는 물을 마신다"] * 15
+        w2v = Word2Vec(vector_size=8, window=2, epochs=2, negative=0,
+                       min_word_frequency=2, seed=3)
+        w2v.fit_sentences(CollectionSentenceIterator(sents),
+                          tokenizer_factory=KoreanTokenizerFactory())
+        assert w2v.get_word_vector("고양이") is not None
+        assert w2v.get_word_vector("는") is not None
+
+    def test_bare_nouns_never_split_and_ascii_punct_stripped(self):
+        # review regressions: suffix-lookalike syllables (고양이, 바나나)
+        # must tokenize identically bare and particle-marked, and ASCII
+        # sentence punctuation must not survive on tokens
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+        tf = KoreanTokenizerFactory()
+        assert tf.create("고양이 귀엽다").get_tokens() == ["고양이", "귀엽다"]
+        assert tf.create("고양이가 논다").get_tokens() == [
+            "고양이", "가", "논다"]
+        assert tf.create("우유를 마신다.").get_tokens() == [
+            "우유", "를", "마신다"]
+        drop = KoreanTokenizerFactory(emit_josa=False)
+        assert drop.create("고양이 우유 바나나").get_tokens() == [
+            "고양이", "우유", "바나나"]
+        # unknown stem + multi-syllable josa still separates
+        assert tf.create("회의실에서 공부한다").get_tokens() == [
+            "회의실", "에서", "공부한다"]
+        # user-extensible lexicon
+        tf.add_noun("판다")
+        assert tf.create("판다가 잔다").get_tokens() == ["판다", "가", "잔다"]
