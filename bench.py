@@ -161,10 +161,35 @@ def run_config(name: str) -> dict:
         # tokens/sec is the natural unit for the LSTM
         out["tokens_per_sec"] = round(out["examples_per_sec"] * 64, 1)
         return out
+    if name == "serving":
+        # inference-path throughput: the continuous-batching HTTP server
+        # vs the lock-serialized per-request baseline, closed-loop
+        # single-row clients (scripts/serve_bench.py has the full
+        # 1/8/64-concurrency report; this is the fast tracked entry)
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "serve_bench.py")
+        spec = importlib.util.spec_from_file_location("serve_bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rep = mod.bench_serving(concurrencies=(16,), requests_per_client=10)
+        c16 = rep["coalesced"]["c16"]
+        return {
+            "rows_per_sec": c16.get("rows_per_sec"),
+            "p50_ms": c16.get("p50_ms"),
+            "p99_ms": c16.get("p99_ms"),
+            "bit_identical": c16.get("bit_identical"),
+            "speedup_vs_serialized": rep.get("speedup_c16"),
+            "coalesce_rows_per_batch":
+                rep["metrics"]["coalesce_rows_per_batch"],
+            "compile_count": rep["metrics"]["compile_count"],
+            "model": rep["model"],
+        }
     raise ValueError(f"unknown bench config '{name}'")
 
 
-_CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256")
+_CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
+            "serving")
 
 
 def main():

@@ -76,6 +76,10 @@ class MultiLayerNetwork:
         self._mesh = None
         self._rng_key = None
         self._rnn_state = None
+        # DL4J_TPU_REMAT resolved at train-step build time (None until
+        # then); later env-var changes are no-ops for this model
+        self.remat_prefixes = None
+        self._remat_warned = False
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
@@ -181,7 +185,6 @@ class MultiLayerNetwork:
         averaging — SURVEY.md §2.8 — and adds the model-parallel axis the
         reference never had.)"""
         self._mesh = (mesh, data_axis)
-        self._tp = (model_axis, tp_rules)  # survives re-placement paths
         self._train_step = None
         self._tbptt_step = None
         self._multi_steps = {}
@@ -205,7 +208,8 @@ class MultiLayerNetwork:
         long-sequence memory lever for stacked LSTMs)."""
         from deeplearning4j_tpu.nn.graph import (_remat_match,
                                                   _remat_prefixes)
-        prefixes = _remat_prefixes()
+        prefixes = (self.remat_prefixes if self.remat_prefixes is not None
+                    else _remat_prefixes())
         spans = {}
         if not prefixes:
             return spans
@@ -317,8 +321,31 @@ class MultiLayerNetwork:
         return data_loss + reg, new_state
 
     # ---------------------------------------------------------- train step
+    def _resolve_remat(self) -> tuple:
+        """Read DL4J_TPU_REMAT exactly ONCE — when the first train step
+        is built — and record the resolved prefixes on the model
+        (``self.remat_prefixes``). The jitted step is cached, so a later
+        env-var change can never take effect; resolving eagerly (and
+        warning on a detected change) keeps remat experiments from
+        silently measuring a stale configuration."""
+        from deeplearning4j_tpu.nn.graph import _remat_prefixes
+        current = _remat_prefixes()
+        if self.remat_prefixes is None:
+            self.remat_prefixes = current
+        elif current != self.remat_prefixes and not self._remat_warned:
+            import warnings
+            warnings.warn(
+                f"DL4J_TPU_REMAT changed to {current!r} after the train "
+                f"step was built with {self.remat_prefixes!r}; the cached "
+                "step ignores the change (set the variable before the "
+                "first training step, or rebuild the model)",
+                RuntimeWarning, stacklevel=3)
+            self._remat_warned = True
+        return self.remat_prefixes
+
     def _step_fn(self):
         """The raw (un-jitted) fused train step: fwd+bwd+normalize+update."""
+        self._resolve_remat()
         gc = self.conf.global_conf
         layers = self.layers
 
@@ -498,6 +525,8 @@ class MultiLayerNetwork:
             return self._fit_tbptt(ds)
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        else:
+            self._resolve_remat()  # warn if DL4J_TPU_REMAT changed since
         self._rng_key, rng = jax.random.split(self._rng_key)
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)

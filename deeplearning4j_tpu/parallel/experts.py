@@ -75,9 +75,11 @@ def moe_ffn(params, x, *, capacity: Optional[int] = None, top_k: int = 1):
     combine_chunks = []
     masked_probs = probs
     occupancy = jnp.zeros((n_experts,), probs.dtype)  # kept tokens so far
+    assign_chunks = []  # pre-capacity routing decisions, per round
     for _ in range(top_k):
         idx = jnp.argmax(masked_probs, axis=-1)          # [T]
         onehot = jax.nn.one_hot(idx, n_experts, dtype=probs.dtype)
+        assign_chunks.append(onehot)
         # 1-based position in the chosen expert's queue, CONTINUING after
         # the slots earlier routing rounds already claimed (per-round
         # restarts would collide round-1 and round-2 tokens in one slot)
@@ -104,7 +106,12 @@ def moe_ffn(params, x, *, capacity: Optional[int] = None, top_k: int = 1):
     y = jnp.einsum("tec,eco->to", combine.astype(x.dtype), expert_out)
 
     # load-balancing auxiliary (GShard/Switch): encourages uniform
-    # routing; differentiable through probs
-    assign = (dispatch.sum(-1) > 0).astype(jnp.float32)  # [T, E]
+    # routing; differentiable through probs. The assignment fraction
+    # comes from the router's PRE-capacity one-hot choices, not the
+    # post-drop dispatch tensor: under heavy overflow the dropped tokens
+    # are concentrated on exactly the overloaded experts, so counting
+    # only kept tokens would under-penalize the imbalance the loss
+    # exists to correct (Switch §2.2 / GShard semantics).
+    assign = sum(assign_chunks).astype(jnp.float32)      # [T, E]
     aux = (probs.mean(0) * assign.mean(0)).sum() * n_experts
     return y, aux

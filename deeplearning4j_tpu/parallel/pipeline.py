@@ -104,9 +104,10 @@ def pipeline_forward(mesh: Mesh, pipe_axis: str, stage_params, x_micro,
 
     spec_p = jax.tree_util.tree_map(
         lambda a: P(pipe_axis, *([None] * (a.ndim - 1))), stage_params)
-    return jax.shard_map(
-        per_device, mesh=mesh, in_specs=(spec_p, P()), out_specs=P(),
-        check_vma=False)(stage_params, x_micro)
+    from deeplearning4j_tpu.parallel.mesh import compat_shard_map
+    return compat_shard_map(
+        per_device, mesh=mesh, in_specs=(spec_p, P()),
+        out_specs=P())(stage_params, x_micro)
 
 
 def pipeline_train_step(mesh: Mesh, pipe_axis: str, stage_fn, loss_fn,

@@ -126,10 +126,10 @@ def sequence_parallel_lstm(mesh: Mesh, seq_axis: str, params, x, h0, c0,
         # shard_map needs a concrete operand per spec — feed a scalar
         # placeholder that the traced body never touches
         mask = jnp.zeros((), x.dtype)
-    fn = jax.shard_map(
+    from deeplearning4j_tpu.parallel.mesh import compat_shard_map
+    fn = compat_shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(None, seq_axis, None), P(), P(),
                   P(None, seq_axis) if has_mask else P()),
-        out_specs=(P(None, seq_axis, None), P(), P()),
-        check_vma=False)
+        out_specs=(P(None, seq_axis, None), P(), P()))
     return fn(params, x, h0, c0, mask)

@@ -3,11 +3,18 @@
 The reference's serving story is a Camel route consuming Kafka and
 calling ``Model.output()``
 (dl4j-streaming/.../routes/DL4jServeRouteBuilder.java:27, route :64).
-SURVEY.md §7 sanctions the TPU-idiomatic substitution: a thin batched
-HTTP inference endpoint over the jitted ``output()`` — Kafka/Camel
-plumbing is environment integration, not framework capability.
+SURVEY.md §7 sanctions the TPU-idiomatic substitution: an HTTP
+inference endpoint over the jitted ``output()`` — Kafka/Camel plumbing
+is environment integration, not framework capability. On top of that
+seam sits a continuous micro-batching runtime (serving/batcher.py):
+cross-request coalescing into padded power-of-two bucket forwards,
+bounded-queue backpressure, warm-up precompile, and ``/metrics``
+observability (serving/metrics.py). See SERVING.md.
 """
 
+from deeplearning4j_tpu.serving.batcher import MicroBatcher, QueueFullError
+from deeplearning4j_tpu.serving.metrics import ServingStats
 from deeplearning4j_tpu.serving.server import ModelServer, serve
 
-__all__ = ["ModelServer", "serve"]
+__all__ = ["ModelServer", "serve", "MicroBatcher", "QueueFullError",
+           "ServingStats"]

@@ -1,0 +1,233 @@
+"""Continuous micro-batching dispatcher: the cross-request coalescing
+core of the serving runtime.
+
+The seed server ran ONE forward per HTTP request under a global lock —
+256 concurrent single-row requests became 256 serialized bucket-1
+forwards and the accelerator idled between dispatches. Here the HTTP
+handler threads only *enqueue*: each request becomes a ticket
+``(features, rows, future)`` in a bounded queue, and a single device
+thread drains whatever is pending, concatenates compatible tickets into
+ONE padded power-of-two bucket forward, then scatters the result rows
+back to each ticket's future. Request-level batching is the classic
+serving lever for accelerator utilization (TF-Serving's batching story);
+the bucket ladder keeps the XLA compile cache bounded exactly as before.
+
+Mechanics:
+- Compatibility: tickets coalesce only when every per-input row shape
+  (everything but the batch dim) matches — multi-input ComputationGraph
+  requests group by their input-arity/shape signature, and a malformed
+  request (wrong feature width) forms its own group so its failure
+  never poisons co-batched well-formed requests.
+- Linger: when the queue is shallow the device thread waits up to
+  ``batch_window_ms`` for more compatible tickets before launching; a
+  full bucket launches immediately. At high concurrency the window
+  never matters (the queue is never empty); at concurrency 1 it is the
+  entire added latency, so keep it small.
+- Backpressure: ``submit`` raises ``QueueFullError`` once ``max_queue``
+  tickets are pending — the HTTP layer turns that into 503 +
+  ``Retry-After`` instead of unbounded memory growth.
+- Drain: ``stop()`` flushes every pending ticket through the device
+  before the thread exits — no request accepted before shutdown is
+  dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the pending-ticket queue is at ``max_queue``."""
+
+
+def next_bucket(n: int, max_batch: int, min_batch: int = 1) -> int:
+    """Power-of-two bucket, capped at ``max_batch``. Requests larger than
+    ``max_batch`` are CHUNKED by the caller (never compiled at raw size —
+    one oversized POST must not grow the XLA compile cache). The
+    ``min_batch`` floor (the dispatcher uses 2) keeps every forward on
+    the same gemm code path: a size-1 bucket lowers to a gemv whose row
+    results can differ in the last ulp from the batched kernel, which
+    would make a reply depend on what traffic it happened to coalesce
+    with."""
+    b = max(1, int(min_batch))
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+class _Ticket:
+    __slots__ = ("feats", "rows", "key", "future")
+
+    def __init__(self, feats, rows, key):
+        self.feats = feats
+        self.rows = rows
+        self.key = key
+        self.future = Future()
+
+
+class MicroBatcher:
+    """Bounded ticket queue + device thread.
+
+    ``forward(feats)`` is the model adapter: it receives the padded
+    bucket-shaped input list and returns the model output (one array or
+    a list/tuple of arrays, each with ``bucket`` rows). It only ever
+    runs on the device thread (and during ``warm()``), so it needs no
+    locking of its own.
+    """
+
+    def __init__(self, forward, *, max_batch: int = 1024,
+                 batch_window_ms: float = 2.0, max_queue: int = 1024,
+                 min_batch: int = 2, stats=None):
+        self._forward = forward
+        self.max_batch = int(max_batch)
+        self.min_batch = min(int(min_batch), self.max_batch)
+        self.batch_window_ms = float(batch_window_ms)
+        self.max_queue = int(max_queue)
+        self.stats = stats
+        self.shapes_seen: set[int] = set()
+        self._pending: deque[_Ticket] = deque()
+        self._cond = threading.Condition()
+        self._thread = None
+        self._stopping = False
+        if stats is not None:
+            stats.queue_depth_fn = lambda: len(self._pending)
+
+    # ---------------------------------------------------------------- warmup
+    def warm(self, row_shapes) -> list[int]:
+        """Precompile the whole bucket ladder (1, 2, 4, ..., max_batch)
+        with zero-filled inputs of the given per-input row shapes, so no
+        live request ever pays an XLA compile stall. Runs synchronously
+        (call before serving traffic). Returns the buckets warmed."""
+        ladder = []
+        b = self.min_batch
+        while True:
+            ladder.append(b)
+            if b >= self.max_batch:
+                break
+            b *= 2
+        for bucket in ladder:
+            feats = [np.zeros((bucket,) + tuple(s), np.float32)
+                     for s in row_shapes]
+            self._forward(feats)
+            self.shapes_seen.add(bucket)
+        return ladder
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        if self._thread is None:
+            self._stopping = False
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="microbatcher-device")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Graceful drain: every already-accepted ticket is executed
+        before the device thread exits; new submits are rejected."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+
+    # --------------------------------------------------------------- enqueue
+    def submit(self, feats: list) -> Future:
+        """Enqueue one request (``feats``: list of arrays, one per model
+        input, equal leading row counts <= max_batch). Returns a Future
+        resolving to the model output sliced back to this ticket's rows."""
+        rows = int(feats[0].shape[0])
+        if rows > self.max_batch:
+            raise ValueError(f"ticket of {rows} rows > max_batch "
+                             f"{self.max_batch} — chunk before submit")
+        key = tuple(tuple(f.shape[1:]) for f in feats)
+        t = _Ticket(feats, rows, key)
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("batcher is stopped")
+            if len(self._pending) >= self.max_queue:
+                if self.stats is not None:
+                    self.stats.record_rejected()
+                raise QueueFullError(
+                    f"{len(self._pending)} tickets pending "
+                    f"(max_queue={self.max_queue})")
+            self._pending.append(t)
+            self._cond.notify_all()
+        return t.future
+
+    # ----------------------------------------------------------- device side
+    def _gather_locked(self):
+        """Pop the oldest ticket plus every later compatible ticket that
+        fits in the bucket; linger up to batch_window_ms for stragglers
+        when the bucket is not full. Called with the lock held."""
+        batch = [self._pending.popleft()]
+        rows = batch[0].rows
+        key = batch[0].key
+
+        def sweep():
+            nonlocal rows
+            kept = deque()
+            while self._pending:
+                t = self._pending.popleft()
+                if t.key == key and rows + t.rows <= self.max_batch:
+                    batch.append(t)
+                    rows += t.rows
+                else:
+                    kept.append(t)
+            self._pending.extendleft(reversed(kept))
+
+        sweep()
+        # linger: wait (releasing the lock) for more compatible tickets
+        # until the bucket fills or the window closes
+        if self.batch_window_ms > 0:
+            deadline = time.monotonic() + self.batch_window_ms / 1000.0
+            while rows < self.max_batch and not self._stopping:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+                sweep()
+        return batch, rows
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._pending and not self._stopping:
+                    self._cond.wait()
+                if not self._pending:
+                    return  # stopping and fully drained
+                batch, rows = self._gather_locked()
+            self._execute(batch, rows)
+
+    def _execute(self, batch, rows):
+        n_inputs = len(batch[0].feats)
+        try:
+            feats = [np.concatenate([t.feats[i] for t in batch])
+                     if len(batch) > 1 else batch[0].feats[i]
+                     for i in range(n_inputs)]
+            bucket = next_bucket(rows, self.max_batch, self.min_batch)
+            if bucket != rows:
+                feats = [np.pad(f, [(0, bucket - rows)] + [(0, 0)]
+                                * (f.ndim - 1)) for f in feats]
+            self.shapes_seen.add(bucket)
+            out = self._forward(feats)
+        except Exception as e:
+            for t in batch:
+                if self.stats is not None:
+                    self.stats.record_error()
+                t.future.set_exception(e)
+            return
+        if self.stats is not None:
+            self.stats.record_batch(bucket, rows, len(batch))
+        many = isinstance(out, (list, tuple))
+        outs = [np.asarray(o) for o in out] if many else [np.asarray(out)]
+        off = 0
+        for t in batch:
+            sliced = [o[off:off + t.rows] for o in outs]
+            off += t.rows
+            t.future.set_result(sliced if many else sliced[0])

@@ -1,0 +1,109 @@
+"""Serving-side observability: counters, latency percentiles, and the
+executed-batch-size histogram behind the ``/metrics`` endpoint.
+
+One ``ServingStats`` instance is shared by the HTTP handlers (request
+counting, per-request latency), the micro-batch dispatcher (executed
+batches, coalesce accounting, queue depth) and the device runner
+(compile count = number of distinct padded bucket shapes, the invariant
+the bucket ladder exists to bound).
+
+Everything is O(1) per event under one lock: latencies go into a
+fixed-size ring (last ``window`` requests — serving dashboards want
+recent percentiles, not since-boot averages), batch sizes into a dict
+histogram keyed by the executed bucket.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ServingStats:
+    """Thread-safe serving counters + a recent-latency ring.
+
+    The coalesce ratio — mean real rows per device forward — is the
+    number that tells you whether cross-request batching is actually
+    happening: 1.0 means every request paid its own forward (the seed
+    lock-serialized behavior), ``max_batch`` means the dispatcher is
+    saturating the bucket ladder.
+    """
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self._lat = [0.0] * self._window   # seconds, ring buffer
+        self._lat_n = 0                     # total ever recorded
+        self.requests = 0                   # accepted /predict requests
+        self.rows = 0                       # real (unpadded) rows served
+        self.batches = 0                    # device forwards executed
+        self.batch_rows = 0                 # real rows over those forwards
+        self.batch_requests = 0             # tickets over those forwards
+        self.rejected = 0                   # 503 admission rejections
+        self.errors = 0                     # 400 request failures
+        self.batch_hist: dict[int, int] = {}  # executed bucket -> count
+        self.queue_depth_fn = lambda: 0     # wired by the dispatcher
+
+    # ------------------------------------------------------------- recording
+    def record_request(self, rows: int, latency_s: float):
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+            self._lat[self._lat_n % self._window] = float(latency_s)
+            self._lat_n += 1
+
+    def record_batch(self, bucket: int, rows: int, n_tickets: int):
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += int(rows)
+            self.batch_requests += int(n_tickets)
+            self.batch_hist[int(bucket)] = self.batch_hist.get(int(bucket),
+                                                               0) + 1
+
+    def record_rejected(self):
+        with self._lock:
+            self.rejected += 1
+
+    def record_error(self):
+        with self._lock:
+            self.errors += 1
+
+    # ------------------------------------------------------------- reporting
+    def _percentiles(self, lats, qs):
+        if not lats:
+            return {f"p{int(q * 100)}": None for q in qs}
+        s = sorted(lats)
+        out = {}
+        for q in qs:
+            # nearest-rank on the recent window — no interpolation noise
+            i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+            out[f"p{int(q * 100)}"] = round(s[i] * 1000.0, 3)
+        return out
+
+    def snapshot(self, shapes_seen=()) -> dict:
+        """One JSON-ready dict — the ``/metrics`` payload."""
+        with self._lock:
+            n = min(self._lat_n, self._window)
+            lats = self._lat[:n]
+            batches = self.batches
+            out = {
+                "requests_total": self.requests,
+                "rows_total": self.rows,
+                "batches_total": batches,
+                "rejected_total": self.rejected,
+                "errors_total": self.errors,
+                "queue_depth": int(self.queue_depth_fn()),
+                "latency_ms": self._percentiles(lats, (0.50, 0.95, 0.99)),
+                "latency_window": n,
+                "batch_size_hist": {str(k): v for k, v in
+                                    sorted(self.batch_hist.items())},
+                # real rows (and tickets) per device forward — the
+                # cross-request coalescing signal
+                "coalesce_rows_per_batch": (
+                    round(self.batch_rows / batches, 3) if batches else None),
+                "coalesce_requests_per_batch": (
+                    round(self.batch_requests / batches, 3) if batches
+                    else None),
+                "compile_count": len(shapes_seen),
+                "shapes_seen": sorted(int(s) for s in shapes_seen),
+            }
+        return out

@@ -1,69 +1,149 @@
-"""Batched HTTP inference server.
+"""Continuous-batching HTTP inference server.
 
 Parity surface: DL4jServeRouteBuilder.java:27,64 (deserialize record ->
-``Model.output()`` -> publish). TPU-native design:
+``Model.output()`` -> publish), grown into a production serving
+runtime. The seed design serialized every request under a global lock —
+one forward per request, accelerator idle between dispatches. This
+version decouples the HTTP threads from the device entirely:
 
-- ONE jitted forward per padded batch-bucket: request batches are padded
-  up to the next power-of-two bucket (capped at ``max_batch``) so XLA
-  compiles a handful of shapes once instead of one program per request
-  size — then rows beyond the real batch are sliced off the reply.
-- Works for MultiLayerNetwork (single ``features`` array) and
-  ComputationGraph (list under ``inputs``; multi-output replies are
-  lists).
+- HTTP handlers *enqueue* tickets into a bounded queue; a single device
+  thread (serving/batcher.py) coalesces whatever is pending — across
+  requests — into ONE padded power-of-two bucket forward, then scatters
+  result rows back to each request's future.
+- ``start()`` warm-up precompiles the whole bucket ladder (when the
+  model's input row shape is inferable or given via ``input_shapes``),
+  so no live request pays the first-compile stall.
+- Admission control: a full queue answers 503 + ``Retry-After`` instead
+  of growing without bound; ``stop()`` drains accepted work first.
+- ``/metrics`` (serving/metrics.py): request/row counters, p50/p95/p99
+  latency, executed-batch-size histogram, queue depth, coalesce ratio,
+  compile count (= ``len(shapes_seen)``).
+
+Works for MultiLayerNetwork (single ``features`` array) and
+ComputationGraph (list under ``inputs``; multi-output replies are
+lists). Multi-input requests coalesce only within the same input
+arity/row-shape group.
 
 Endpoints:
 - ``POST /predict``  {"features": [[...]]} or {"inputs": [[[...]], ...]}
   -> {"predictions": ...}
 - ``GET /healthz``   liveness + model summary sizes
+- ``GET /metrics``   ServingStats snapshot (JSON)
 """
 
 from __future__ import annotations
 
 import json
-import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from deeplearning4j_tpu.serving.batcher import (MicroBatcher, QueueFullError,
+                                                next_bucket)
+from deeplearning4j_tpu.serving.metrics import ServingStats
 
-def _next_bucket(n: int, max_batch: int) -> int:
-    """Power-of-two bucket, capped at ``max_batch``. Requests larger than
-    ``max_batch`` are CHUNKED by the caller (never compiled at raw size —
-    one oversized POST must not grow the XLA compile cache; the reference
-    route consumes any-size payloads the same way,
-    DL4jServeRouteBuilder.java:64)."""
-    b = 1
-    while b < n:
-        b *= 2
-    return min(b, max_batch)
+_next_bucket = next_bucket  # back-compat alias (seed name)
+
+
+class _ServingHTTPServer(ThreadingHTTPServer):
+    # default listen backlog is 5 — a 64-client closed-loop burst gets
+    # connection resets before a single handler thread even spawns
+    request_queue_size = 128
 
 
 class ModelServer:
     def __init__(self, net, host: str = "127.0.0.1", port: int = 9500,
-                 max_batch: int = 1024):
+                 max_batch: int = 1024, batch_window_ms: float = 2.0,
+                 max_queue: int = 1024, warmup: bool = True,
+                 input_shapes=None):
         self.net = net
         self.host = host
         self.port = port
         self.max_batch = max_batch
+        self.warmup = warmup
+        self.input_shapes = input_shapes
         self._httpd = None
         self._thread = None
-        self._lock = threading.Lock()
-        # every distinct padded batch shape handed to the device — the
-        # compile count is bounded by len(shapes_seen) (asserted by the
-        # serving concurrency test)
-        self.shapes_seen: set[int] = set()
         self._is_graph = hasattr(net, "conf") and hasattr(
             net.conf, "network_inputs")
+        self.stats = ServingStats()
+        self._batcher = MicroBatcher(
+            self._device_forward, max_batch=max_batch,
+            batch_window_ms=batch_window_ms, max_queue=max_queue,
+            stats=self.stats)
+        # every distinct padded batch shape handed to the device (warm-up
+        # ladder included) — the compile count is bounded by
+        # len(shapes_seen) (asserted by the serving concurrency test)
+        self.shapes_seen = self._batcher.shapes_seen
+
+    # ------------------------------------------------------------ device side
+    def _device_forward(self, feats):
+        """Model adapter run only on the batcher's device thread."""
+        if self._is_graph:
+            return self.net.output(*feats)
+        return self.net.output(feats[0])
+
+    def _infer_row_shapes(self):
+        """Per-input row shapes (no batch dim) for warm-up, when they can
+        be derived from the configuration; None disables warm-up."""
+        if self.input_shapes is not None:
+            return [tuple(s) for s in self.input_shapes]
+
+        def from_itype(it):
+            if it is None:
+                return None
+            if it.kind in ("feed_forward", "convolutional_flat"):
+                return (it.size,)
+            if it.kind == "convolutional":
+                return (it.height, it.width, it.channels)
+            if it.kind == "recurrent" and it.timesteps:
+                return (it.timesteps, it.size)
+            return None
+
+        def from_conf(lc):
+            from deeplearning4j_tpu.nn.conf.layers import (
+                FeedForwardLayerConfig)
+            from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+                BaseRecurrentConfig)
+            if (isinstance(lc, FeedForwardLayerConfig)
+                    and not isinstance(lc, BaseRecurrentConfig)
+                    and getattr(lc, "n_in", None)):
+                return (lc.n_in,)
+            return None
+
+        if self._is_graph:
+            its = getattr(self.net.conf, "input_types", None)
+            if its:
+                shapes = [from_itype(it) for it in its]
+                return None if any(s is None for s in shapes) else shapes
+            shapes = []
+            for name in self.net.conf.network_inputs:
+                s = None
+                for v, ins in self.net.conf.vertex_inputs.items():
+                    if name in ins:
+                        s = from_conf(self.net._resolved_confs.get(v))
+                        if s is not None:
+                            break
+                if s is None:
+                    return None
+                shapes.append(s)
+            return shapes
+        s = from_itype(getattr(self.net.conf, "input_type", None))
+        if s is None and getattr(self.net.conf, "layers", None):
+            s = from_conf(self.net.conf.layers[0])
+        return None if s is None else [s]
 
     # ------------------------------------------------------------ inference
     def predict(self, features):
-        """Pad to the bucket size, run the jitted forward, slice back.
-        Requests larger than ``max_batch`` are split into ``max_batch``
-        chunks so they reuse the already-compiled full-bucket program
-        instead of compiling a fresh XLA executable of arbitrary shape.
-        ``features``: one array (sequential net) or list of arrays (graph).
-        Serialized under a lock — device execution is the shared
-        resource; HTTP threads queue here."""
+        """Enqueue the request into the micro-batcher and wait for the
+        scattered result rows. Requests larger than ``max_batch`` are
+        split into ``max_batch`` chunks so they reuse the already-compiled
+        full-bucket program instead of compiling a fresh XLA executable of
+        arbitrary shape. ``features``: one array (sequential net) or list
+        of arrays (graph). Raises QueueFullError when admission control
+        rejects (mapped to HTTP 503)."""
+        t0 = time.perf_counter()
         many = isinstance(features, (list, tuple))
         if many and not self._is_graph and len(features) != 1:
             raise ValueError(
@@ -73,45 +153,57 @@ class ModelServer:
         feats = [np.asarray(f, np.float32)
                  for f in (features if many else [features])]
         n = feats[0].shape[0]
-        if n > self.max_batch:
-            chunks = [self._predict_bucketed(
-                          [f[i:i + self.max_batch] for f in feats])
-                      for i in range(0, n, self.max_batch)]
-            if isinstance(chunks[0], list):
-                return [np.concatenate([c[k] for c in chunks])
-                        for k in range(len(chunks[0]))]
-            return np.concatenate(chunks)
-        return self._predict_bucketed(feats)
-
-    def _predict_bucketed(self, feats):
-        n = feats[0].shape[0]
-        bucket = _next_bucket(n, self.max_batch)
-        if bucket != n:
-            feats = [np.pad(f, [(0, bucket - n)] + [(0, 0)] * (f.ndim - 1))
-                     for f in feats]
-        self.shapes_seen.add(bucket)
-        with self._lock:
-            if self._is_graph:
-                out = self.net.output(*feats)
-            else:
-                out = self.net.output(feats[0])
-        if isinstance(out, (list, tuple)):
-            return [np.asarray(o)[:n] for o in out]
-        return np.asarray(out)[:n]
+        if any(f.shape[0] != n for f in feats):
+            raise ValueError("all inputs must have the same number of rows")
+        self._batcher.start()  # idempotent; lazy for direct predict() use
+        futures = [self._batcher.submit(
+                       [f[i:i + self.max_batch] for f in feats])
+                   for i in range(0, max(n, 1), self.max_batch)]
+        chunks = [f.result(timeout=300) for f in futures]
+        if isinstance(chunks[0], list):
+            out = [np.concatenate([c[k] for c in chunks])
+                   if len(chunks) > 1 else chunks[0][k]
+                   for k in range(len(chunks[0]))]
+        else:
+            out = (np.concatenate(chunks) if len(chunks) > 1 else chunks[0])
+        self.stats.record_request(n, time.perf_counter() - t0)
+        return out
 
     # -------------------------------------------------------------- server
     def start(self):
         server = self
 
+        if self.warmup:
+            shapes = self._infer_row_shapes()
+            if shapes is not None:
+                try:
+                    self._batcher.warm(shapes)
+                except Exception:
+                    # warm-up is an optimization: a shape-inference miss
+                    # must never block serving (first requests compile
+                    # lazily, exactly as the seed server did)
+                    self.shapes_seen.clear()
+        self._batcher.start()
+
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: closed-loop clients reuse their
+            # connection instead of paying a TCP handshake per request
+            # (every reply carries Content-Length, so this is safe).
+            # Nagle off, or the two-segment request/reply pattern hits
+            # the 40 ms delayed-ACK stall on every round trip.
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):
                 pass
 
-            def _json(self, obj, code=200):
+            def _json(self, obj, code=200, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -120,6 +212,8 @@ class ModelServer:
                     self._json({"status": "ok",
                                 "params": int(server.net.num_params()),
                                 "graph": server._is_graph})
+                elif self.path.startswith("/metrics"):
+                    self._json(server.stats.snapshot(server.shapes_seen))
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -136,15 +230,21 @@ class ModelServer:
                     else:
                         out = server.predict(np.asarray(payload["features"]))
                     if isinstance(out, list):
-                        preds = [o.tolist() for o in out]
+                        preds = [np.asarray(o).tolist() for o in out]
                     else:
-                        preds = out.tolist()
+                        preds = np.asarray(out).tolist()
                     self._json({"predictions": preds})
+                except QueueFullError as e:
+                    # backpressure: shed load instead of growing the queue
+                    self._json({"error": f"overloaded: {e}"}, 503,
+                               headers=(("Retry-After", "1"),))
                 except Exception as e:  # surface as a 400, keep serving
+                    server.stats.record_error()
                     self._json({"error": f"{type(e).__name__}: {e}"}, 400)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = _ServingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        import threading
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -154,14 +254,25 @@ class ModelServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def metrics(self) -> dict:
+        """ServingStats snapshot (same payload as ``GET /metrics``)."""
+        return self.stats.snapshot(self.shapes_seen)
+
     def stop(self):
+        """Stop accepting, then drain: every accepted ticket completes
+        before the device thread exits."""
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        self._batcher.stop()
 
 
 def serve(net, host: str = "127.0.0.1", port: int = 9500,
-          max_batch: int = 1024) -> ModelServer:
+          max_batch: int = 1024, batch_window_ms: float = 2.0,
+          max_queue: int = 1024, warmup: bool = True,
+          input_shapes=None) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
-    return ModelServer(net, host, port, max_batch).start()
+    return ModelServer(net, host, port, max_batch,
+                       batch_window_ms=batch_window_ms, max_queue=max_queue,
+                       warmup=warmup, input_shapes=input_shapes).start()

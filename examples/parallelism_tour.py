@@ -19,7 +19,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# APPEND the virtual-device flag to any pre-existing XLA_FLAGS instead
+# of setdefault: a user running e.g. XLA_FLAGS=--xla_dump_to=/tmp/d
+# would otherwise silently lose the 8-device mesh (1 device -> every
+# Mesh below fails) because setdefault keeps their value verbatim
+_FLAG = "--xla_force_host_platform_device_count=8"
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " " + _FLAG).strip()
 
 import jax
 
@@ -46,6 +54,13 @@ def build_mlp(n_out=32):
 
 
 def main():
+    if len(jax.devices()) < 8:
+        sys.exit(
+            f"parallelism_tour needs 8 devices, found {len(jax.devices())}. "
+            "The XLA backend initialized before the virtual-device flag "
+            "took effect — run with XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 (or unset any "
+            "conflicting --xla_force_host_platform_device_count value).")
     devices = np.array(jax.devices()[:8])
     rng = np.random.default_rng(0)
     x = rng.normal(size=(64, 16)).astype(np.float32)

@@ -72,6 +72,27 @@ class TestRouting:
         assert (norms[:4] > 1e-3).all()
         np.testing.assert_allclose(norms[4:], 0.0, atol=1e-6)
 
+    def test_aux_loss_counts_pre_capacity_assignment(self):
+        """Switch/GShard semantics: the balancing loss is computed from
+        the router's PRE-capacity one-hot assignment, so an expert that
+        overflows (and drops tokens) is penalized for ALL the tokens
+        routed at it — capacity must not change the loss."""
+        params = _params(E=2)
+        params = dict(params)
+        # tie-broken argmax routes ALL 16 tokens to expert 0
+        params["router"] = jnp.zeros_like(params["router"])
+        x = jnp.asarray(np.random.default_rng(9).normal(size=(16, 8)),
+                        jnp.float32)
+        _, aux_overflow = moe_ffn(params, x, capacity=4, top_k=1)  # 12 drop
+        _, aux_ample = moe_ffn(params, x, capacity=16, top_k=1)   # none drop
+        # pre-drop counting: identical aux whether or not tokens dropped
+        np.testing.assert_allclose(float(aux_overflow), float(aux_ample),
+                                   rtol=1e-6)
+        # uniform probs (0.5 each), all assignment on expert 0 ->
+        # aux = E * (0.5 * 1.0 + 0.5 * 0.0) = 1.0; the post-drop tensor
+        # would report 2 * 0.5 * (4/16) = 0.25, hiding the overflow
+        np.testing.assert_allclose(float(aux_overflow), 1.0, rtol=1e-6)
+
     def test_aux_loss_prefers_balance(self):
         params = _params(E=4)
         x = jnp.asarray(np.random.default_rng(3).normal(size=(64, 8)),

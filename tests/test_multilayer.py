@@ -5,6 +5,7 @@ regression-test surface)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
 from deeplearning4j_tpu.nn.conf import (
@@ -280,6 +281,36 @@ def test_mln_selective_remat_exact_in_f32(monkeypatch):
             np.testing.assert_array_equal(
                 np.asarray(base.params[ln][pn]),
                 np.asarray(rem.params[ln][pn]), err_msg=f"{ln}.{pn}")
+
+
+def test_remat_env_pinned_at_step_build(monkeypatch):
+    """DL4J_TPU_REMAT is resolved ONCE when the first train step is
+    built and recorded on the model; changing the env var afterwards is
+    a warned no-op (the jitted step is cached and cannot change)."""
+    import warnings
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Sgd(0.05)).list()
+            .layer(Dense(n_in=6, n_out=8, activation="tanh"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    ds = DataSet(rng.normal(size=(4, 6)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)])
+
+    monkeypatch.setenv("DL4J_TPU_REMAT", "layer_")
+    assert net.remat_prefixes is None  # unresolved until first step
+    net.fit_batch(ds)
+    assert net.remat_prefixes == ("layer_",)
+
+    monkeypatch.setenv("DL4J_TPU_REMAT", "other_")
+    with pytest.warns(RuntimeWarning, match="DL4J_TPU_REMAT changed"):
+        net.fit_batch(ds)
+    assert net.remat_prefixes == ("layer_",)  # pinned, not re-read
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # warned once, not per step
+        net.fit_batch(ds)
 
 
 def test_remat_match_anchors_exact_names():
