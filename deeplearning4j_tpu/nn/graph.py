@@ -81,6 +81,34 @@ class ComputationGraph:
         # then); later env-var changes are no-ops for this model
         self.remat_prefixes = None
         self._remat_warned = False
+        # runtime learning-rate multiplier (resilience NaN backoff); a
+        # compile-time constant of the fused step — set via set_lr_scale
+        self._lr_scale = 1.0
+
+    def set_lr_scale(self, scale: float):
+        """Scale every layer's scheduled learning rate by ``scale`` from
+        the next step on (resilience/supervisor.py backs off the rate
+        after a NaN rollback). Baked into the compiled step — every
+        cached step variant is invalidated, so expect one recompile per
+        change."""
+        scale = float(scale)
+        if scale <= 0.0:
+            raise ValueError(f"lr scale must be > 0, got {scale}")
+        if scale != self._lr_scale:
+            self._lr_scale = scale
+            self._train_step = None
+            self._tbptt_step = None
+            self._multi_steps = {}
+        return self
+
+    def resilient_fit(self, data, labels=None, *, checkpoint_dir: str,
+                      epochs: int = 1, batch_size: int = 32, **supervisor_kw):
+        """Supervised ``fit`` with checkpoint/resume, retry, NaN rollback
+        and preemption handling — see resilience/supervisor.py."""
+        from deeplearning4j_tpu.resilience import resilient_fit
+        return resilient_fit(self, data, labels,
+                             checkpoint_dir=checkpoint_dir, epochs=epochs,
+                             batch_size=batch_size, **supervisor_kw)
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
@@ -481,6 +509,7 @@ class ComputationGraph:
         self._resolve_remat()
         gc = self.conf.global_conf
         layers = self.layers
+        lr_scale = self._lr_scale
 
         def loss_fn(params, state, inputs, labels, fmasks, lmasks, rng):
             return self._loss(params, state, inputs, labels, fmasks, lmasks,
@@ -492,7 +521,7 @@ class ComputationGraph:
                 loss_fn, has_aux=True)(params, state, inputs, labels, fmasks,
                                        lmasks, rng)
             new_params, new_opt = apply_layer_updates(
-                layers, gc, params, grads, opt_state, it)
+                layers, gc, params, grads, opt_state, it, lr_scale)
             return new_params, new_state, new_opt, score
 
         return step_fn

@@ -80,6 +80,9 @@ class MultiLayerNetwork:
         # then); later env-var changes are no-ops for this model
         self.remat_prefixes = None
         self._remat_warned = False
+        # runtime learning-rate multiplier (resilience NaN backoff); a
+        # compile-time constant of the fused step — set via set_lr_scale
+        self._lr_scale = 1.0
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, *, structure_only: bool = False):
@@ -165,6 +168,23 @@ class MultiLayerNetwork:
                 upd = layer.resolve("updater")
                 opt_state[layer.name] = upd.init_state(self.params[layer.name])
         self.opt_state = opt_state
+
+    def set_lr_scale(self, scale: float):
+        """Scale every layer's scheduled learning rate by ``scale`` from
+        the next step on (resilience/supervisor.py backs off the rate
+        after a NaN rollback). The scale is baked into the compiled step,
+        so every cached step variant is invalidated — expect one
+        recompile per change, which is why this is a recovery lever and
+        not a schedule."""
+        scale = float(scale)
+        if scale <= 0.0:
+            raise ValueError(f"lr scale must be > 0, got {scale}")
+        if scale != self._lr_scale:
+            self._lr_scale = scale
+            self._train_step = None
+            self._tbptt_step = None
+            self._multi_steps = {}
+        return self
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -348,6 +368,7 @@ class MultiLayerNetwork:
         self._resolve_remat()
         gc = self.conf.global_conf
         layers = self.layers
+        lr_scale = self._lr_scale
 
         def loss_fn(params, state, x, labels, fmask, lmask, rng):
             return self._loss(params, state, x, labels, fmask, lmask, rng)
@@ -357,7 +378,7 @@ class MultiLayerNetwork:
                 loss_fn, has_aux=True)(params, state, x, labels, fmask, lmask,
                                        rng)
             new_params, new_opt = apply_layer_updates(
-                layers, gc, params, grads, opt_state, it)
+                layers, gc, params, grads, opt_state, it, lr_scale)
             return new_params, new_state, new_opt, score
 
         return step_fn
@@ -564,6 +585,17 @@ class MultiLayerNetwork:
             self.epoch += 1
             it.reset()
         return self
+
+    def resilient_fit(self, data, labels=None, *, checkpoint_dir: str,
+                      epochs: int = 1, batch_size: int = 32, **supervisor_kw):
+        """Supervised ``fit``: periodic checkpoints to fresh step
+        directories, auto-resume from the newest valid one, transient-step
+        retry, NaN rollback + LR backoff, SIGTERM preemption handling
+        (resilience/supervisor.py). Returns the SupervisorResult."""
+        from deeplearning4j_tpu.resilience import resilient_fit
+        return resilient_fit(self, data, labels,
+                             checkpoint_dir=checkpoint_dir, epochs=epochs,
+                             batch_size=batch_size, **supervisor_kw)
 
     # ------------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

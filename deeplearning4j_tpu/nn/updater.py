@@ -399,10 +399,16 @@ def normalize_gradients(grads, mode: str | None, threshold: float = 1.0):
     raise ValueError(f"Unknown gradient normalization mode: {mode}")
 
 
-def apply_layer_updates(layers, gc, params, grads, opt_state, it):
+def apply_layer_updates(layers, gc, params, grads, opt_state, it,
+                        lr_scale: float = 1.0):
     """Apply per-layer gradient normalization + updater to every
     parameterized layer (LayerUpdater.update :74 / preApply :186 semantics,
     shared by MultiLayerNetwork and ComputationGraph train steps).
+
+    ``lr_scale`` multiplies every layer's scheduled rate — the runtime
+    lever the resilience supervisor pulls after a NaN rollback (it is a
+    compile-time constant of the step; nets invalidate their cached step
+    when it changes).
 
     Returns (new_params, new_opt_state)."""
     new_params = dict(params)
@@ -422,7 +428,7 @@ def apply_layer_updates(layers, gc, params, grads, opt_state, it):
             base_lr = gc.learning_rate
         if base_lr is None:
             base_lr = upd.learning_rate
-        lr = gc.lr_schedule(base_lr, it)
+        lr = gc.lr_schedule(base_lr, it) * lr_scale
         deltas, new_opt[name] = upd.update(g, opt_state[name], lr)
         new_params[name] = jax.tree_util.tree_map(
             lambda p, d: p - d, params[name], deltas)

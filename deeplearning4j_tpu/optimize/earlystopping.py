@@ -198,10 +198,20 @@ class LocalFileModelSaver:
             write_computation_graph, write_model)
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         path = os.path.join(self.directory, fname)
-        if isinstance(net, MultiLayerNetwork):
-            write_model(net, path)
-        else:
-            write_computation_graph(net, path)
+        # write-temp-then-rename: a crash mid-save must never leave a
+        # truncated zip where the previous (valid) best/latest model was
+        # — the rename is atomic, so readers see old-complete or
+        # new-complete, nothing in between
+        tmp = path + ".tmp"
+        try:
+            if isinstance(net, MultiLayerNetwork):
+                write_model(net, tmp)
+            else:
+                write_computation_graph(net, tmp)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return path
 
     def save_best(self, net):

@@ -32,6 +32,13 @@ class TrainingListener:
     def on_epoch_end(self, net):
         pass
 
+    def on_recovery(self, net, event):
+        """Resilience hook: called by the TrainingSupervisor with a
+        resilience.RecoveryEvent for every checkpoint / resume / retry /
+        rollback / preemption (no reference analogue — the reference has
+        no recovery loop to observe)."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     """Logs the loss every N iterations (ScoreIterationListener parity)."""
@@ -121,6 +128,27 @@ class PerformanceListener(TrainingListener):
             self._examples = 0
 
 
+class RecoveryEventListener(TrainingListener):
+    """Collects (and optionally logs) supervisor recovery events — the
+    listener-tier view of the resilience runtime's restarts, rollbacks
+    and retries (ResilienceStats carries the counter view)."""
+
+    def __init__(self, log: bool = True):
+        self.log = log
+        self.events: list = []
+
+    def on_recovery(self, net, event):
+        self.events.append(event)
+        if self.log:
+            logger.warning("recovery: %s", event)
+
+    def counts(self) -> dict:
+        out: dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+
 class ComposableIterationListener(TrainingListener):
     def __init__(self, *listeners):
         self.listeners = listeners
@@ -136,6 +164,10 @@ class ComposableIterationListener(TrainingListener):
     def on_epoch_end(self, net):
         for l in self.listeners:
             l.on_epoch_end(net)
+
+    def on_recovery(self, net, event):
+        for l in self.listeners:
+            l.on_recovery(net, event)
 
 
 class ProfilerListener(TrainingListener):

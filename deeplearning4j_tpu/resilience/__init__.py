@@ -1,0 +1,36 @@
+"""Fault-tolerant training runtime (RESILIENCE.md).
+
+``TrainingSupervisor`` / ``resilient_fit`` wrap ``fit`` with periodic
+checkpointing + atomic latest-pointer + retention GC, auto-resume from
+the newest valid checkpoint, transient-step retry with exponential
+backoff, a NaN/Inf rollback sentinel with learning-rate backoff, and
+clean SIGTERM preemption. ``faultinject`` provides the deterministic
+fault harness that keeps every one of those paths under test."""
+
+from deeplearning4j_tpu.resilience.faultinject import (
+    FaultInjector,
+    InjectedCrash,
+    TransientStepError,
+)
+from deeplearning4j_tpu.resilience.supervisor import (
+    RecoveryEvent,
+    ResilienceStats,
+    SupervisorConfig,
+    SupervisorResult,
+    TrainingDivergedError,
+    TrainingSupervisor,
+    resilient_fit,
+)
+
+__all__ = [
+    "FaultInjector",
+    "InjectedCrash",
+    "RecoveryEvent",
+    "ResilienceStats",
+    "SupervisorConfig",
+    "SupervisorResult",
+    "TrainingDivergedError",
+    "TrainingSupervisor",
+    "TransientStepError",
+    "resilient_fit",
+]

@@ -1,0 +1,151 @@
+"""Deterministic fault injection for the resilience runtime.
+
+Every recovery path the TrainingSupervisor implements is exercised by
+tests through this harness rather than hoped for:
+
+- :meth:`FaultInjector.crash_during_save` — raise :class:`InjectedCrash`
+  between the orbax tree commit and the ``meta.json`` rename (the
+  ``_POST_COMMIT_HOOK`` seam in utils/checkpoint.py), leaving exactly
+  the partial-save footprint a real preemption leaves.
+- :meth:`FaultInjector.fail_step` — raise :class:`TransientStepError`
+  the first *times* attempts of a given step (exercises
+  retry-with-backoff).
+- :meth:`FaultInjector.poison_step` — overwrite one parameter leaf with
+  NaN before a given step, so the fused step produces a non-finite loss
+  (exercises the sentinel rollback + LR backoff).
+- :meth:`FaultInjector.preempt_at_step` — request a clean preemption at
+  a step boundary (exercises the SIGTERM path without relying on signal
+  delivery timing); :meth:`sigterm_at_step` delivers a real SIGTERM to
+  the process instead.
+
+Faults are keyed by absolute step / save index, so a plan replays
+identically across process restarts — scripts/chaos_train.py relies on
+that to assert a chaos run converges to the uninterrupted run's exact
+parameters.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import numpy as np
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death. Deliberately a BaseException: nothing in
+    the supervisor (or any library ``except Exception``) may swallow it,
+    exactly like a real SIGKILL."""
+
+
+class TransientStepError(RuntimeError):
+    """A step failure worth retrying (the injected stand-in for flaky
+    device/runtime errors)."""
+
+
+class FaultInjector:
+    """A deterministic fault plan. Plan with the ``*_at``/``*_step``
+    methods, pass the injector to the TrainingSupervisor, and wrap the
+    run in :meth:`installed` when the plan includes save crashes (that
+    arms the checkpoint post-commit hook)."""
+
+    def __init__(self):
+        self._step_failures = {}      # step -> remaining raise count
+        self._poison_steps = {}       # step -> remaining poison count
+        self._preempt_steps = set()   # clean preemption request
+        self._sigterm_steps = set()   # real SIGTERM delivery
+        self._crash_saves = set()     # save index -> crash post-commit
+        self._save_index = 0
+        self.log: list[tuple] = []    # (fault, step/index) actually fired
+
+    # ------------------------------------------------------------- planning
+    def fail_step(self, step: int, times: int = 1,):
+        """Raise TransientStepError on the first ``times`` attempts of
+        ``step`` (attempt times+1 then succeeds — retry fodder)."""
+        self._step_failures[int(step)] = int(times)
+        return self
+
+    def poison_step(self, step: int, times: int = 1):
+        """Before ``step`` (its first ``times`` attempts), set one
+        parameter leaf to NaN — the fused step then yields a non-finite
+        loss, like a gradient blow-up or corrupted device buffer."""
+        self._poison_steps[int(step)] = int(times)
+        return self
+
+    def preempt_at_step(self, step: int):
+        """Request a clean preemption once ``step`` is reached (the
+        supervisor finishes the in-flight step, checkpoints, exits)."""
+        self._preempt_steps.add(int(step))
+        return self
+
+    def sigterm_at_step(self, step: int):
+        """Deliver a real SIGTERM to this process at ``step`` — the
+        supervisor's installed handler must turn it into a clean
+        checkpoint-and-exit."""
+        self._sigterm_steps.add(int(step))
+        return self
+
+    def crash_during_save(self, save_index: int):
+        """Crash the ``save_index``-th checkpoint save (0-based, counted
+        while :meth:`installed` is active) between the tree commit and
+        the meta.json rename — the window that yields a partial save."""
+        self._crash_saves.add(int(save_index))
+        return self
+
+    # ------------------------------------------------------ checkpoint seam
+    @contextmanager
+    def installed(self):
+        """Arm the utils/checkpoint.py post-commit hook for the duration
+        of the block (save-crash faults only fire while armed)."""
+        from deeplearning4j_tpu.utils import checkpoint
+        prev = checkpoint._POST_COMMIT_HOOK
+        checkpoint._POST_COMMIT_HOOK = self._post_commit
+        try:
+            yield self
+        finally:
+            checkpoint._POST_COMMIT_HOOK = prev
+
+    def _post_commit(self, path: str):
+        idx = self._save_index
+        self._save_index += 1
+        if idx in self._crash_saves:
+            self._crash_saves.discard(idx)
+            self.log.append(("crash_save", idx))
+            raise InjectedCrash(
+                f"injected crash between tree commit and meta rename "
+                f"(save #{idx}, {path})")
+
+    # -------------------------------------------------------- step-time hook
+    def before_step(self, supervisor, net, step: int):
+        """Called by the supervisor inside the retried region, once per
+        attempt of ``step``."""
+        if step in self._sigterm_steps:
+            self._sigterm_steps.discard(step)
+            self.log.append(("sigterm", step))
+            import os
+            import signal
+            os.kill(os.getpid(), signal.SIGTERM)
+        if step in self._preempt_steps:
+            self._preempt_steps.discard(step)
+            self.log.append(("preempt", step))
+            supervisor.request_preemption()
+        if self._poison_steps.get(step, 0) > 0:
+            self._poison_steps[step] -= 1
+            self.log.append(("poison", step))
+            _poison_params(net)
+        if self._step_failures.get(step, 0) > 0:
+            self._step_failures[step] -= 1
+            self.log.append(("transient", step))
+            raise TransientStepError(f"injected transient failure at "
+                                     f"step {step}")
+
+
+def _poison_params(net):
+    """NaN one parameter leaf in place (first layer, first tensor)."""
+    import jax.numpy as jnp
+    params = dict(net.params)
+    name = next(iter(params))
+    sub = dict(params[name])
+    key = next(iter(sub))
+    sub[key] = jnp.full_like(sub[key], jnp.nan)
+    params[name] = sub
+    net.params = params

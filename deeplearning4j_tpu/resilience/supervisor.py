@@ -1,0 +1,415 @@
+"""Fault-tolerant training runtime: a supervisor around ``fit``.
+
+SURVEY.md §5.3 calls preemption-resume the TPU stack's fault-tolerance
+answer, and utils/checkpoint.py provides the raw primitive — but nothing
+in the seed *supervised* a long fit() run: a crash, a NaN blow-up or a
+TPU preemption simply lost the run. The TrainingSupervisor closes that
+gap (the TensorFlow checkpoint/recovery loop of Abadi et al. §4.4,
+rendered onto this framework's fused-step training):
+
+- **Periodic checkpointing** to fresh ``step_<n>`` directories (the
+  crash-atomic discipline utils/checkpoint.py documents), plus an
+  atomically-renamed ``LATEST`` pointer file and retention GC that keeps
+  the newest ``keep_checkpoints`` valid steps.
+- **Auto-resume**: a relaunched supervisor discovers the newest *valid*
+  checkpoint (``find_latest_checkpoint`` skips partial saves missing
+  ``meta.json``) and continues to the same absolute target step.
+- **Transient-step retry**: exceptions of the configured types are
+  retried with exponential backoff before giving up.
+- **NaN/Inf sentinel**: a non-finite loss rolls the net back to the last
+  good checkpoint and backs off the learning rate
+  (``net.set_lr_scale``); poisoned parameters are never checkpointed.
+- **Preemption (SIGTERM)**: the in-flight step finishes, a final
+  checkpoint is written, and ``run`` returns with status ``preempted``.
+
+Every recovery action is emitted as a :class:`RecoveryEvent` through the
+net's listeners (``TrainingListener.on_recovery``), counted in
+:class:`ResilienceStats` (a ``/metrics``-style ``snapshot()``), and the
+checkpoint saves are timed as ``checkpoint_barrier`` phases when a
+``parallel.stats.TrainingStatsCollector`` is supplied.
+
+Deterministic fault injection for all of these paths lives in
+resilience/faultinject.py; scripts/chaos_train.py drives them end to end
+and asserts bit-identical final parameters vs an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import shutil
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_LATEST_POINTER = "LATEST"
+
+
+class TrainingDivergedError(RuntimeError):
+    """The NaN sentinel exhausted ``max_nan_rollbacks`` — training keeps
+    producing non-finite losses even after rollback + LR backoff."""
+
+
+@dataclass(frozen=True)
+class RecoveryEvent:
+    """One supervisor action: kind is ``resume`` | ``checkpoint`` |
+    ``retry`` | ``rollback`` | ``preempt`` | ``gc``."""
+    kind: str
+    step: int
+    detail: str = ""
+
+    def __str__(self):
+        return f"[{self.kind} @ step {self.step}] {self.detail}"
+
+
+class ResilienceStats:
+    """Thread-safe recovery counters — the observability surface the
+    serving tier's ServingStats provides for inference, for training:
+    restarts, rollbacks and retry counts are numbers a dashboard can
+    poll, not log lines."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.resumes = 0
+        self.checkpoints = 0
+        self.retries = 0
+        self.rollbacks = 0
+        self.preemptions = 0
+        self.gc_removed = 0
+
+    def bump(self, counter: str, n: int = 1):
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "resumes_total": self.resumes,
+                "checkpoints_total": self.checkpoints,
+                "retries_total": self.retries,
+                "rollbacks_total": self.rollbacks,
+                "preemptions_total": self.preemptions,
+                "checkpoints_gc_total": self.gc_removed,
+            }
+
+
+def _default_retry_on():
+    from deeplearning4j_tpu.resilience.faultinject import TransientStepError
+    return (TransientStepError,)
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs for one supervised run (RESILIENCE.md has the failure
+    matrix these map onto)."""
+
+    checkpoint_dir: str
+    checkpoint_every_steps: int = 100
+    keep_checkpoints: int = 3
+    resume: bool = True
+    #: exception types treated as transient and retried with backoff;
+    #: anything else propagates immediately
+    retry_on: tuple = field(default_factory=_default_retry_on)
+    max_step_retries: int = 3
+    backoff_initial_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 5.0
+    #: multiply the learning rate by this after each NaN rollback
+    nan_lr_backoff: float = 0.5
+    max_nan_rollbacks: int = 3
+    #: check the loss for NaN/Inf every n steps (each check syncs the
+    #: device; 1 = catch poison before it can ever be checkpointed)
+    nan_check_every: int = 1
+    handle_sigterm: bool = True
+    #: injectable for tests (real runs sleep through backoff)
+    sleep_fn: Callable[[float], None] = time.sleep
+
+
+@dataclass
+class SupervisorResult:
+    status: str                    # "completed" | "preempted"
+    final_step: int
+    resumed_from: Optional[str]
+    events: List[RecoveryEvent]
+    stats: dict
+
+
+class TrainingSupervisor:
+    """Wraps ``MultiLayerNetwork.fit`` / ``ComputationGraph.fit`` in the
+    checkpoint/recovery loop. The core entry point is :meth:`run` (a
+    deterministic ``batch_fn(step) -> DataSet`` plus an absolute target
+    step — exactly resumable because the data for step *i* never depends
+    on how many times the process died); :meth:`fit` adapts the familiar
+    (data, labels, epochs, batch_size) surface onto it."""
+
+    def __init__(self, net, config: SupervisorConfig, *, injector=None,
+                 stats_collector=None):
+        self.net = net
+        self.config = config
+        self.injector = injector
+        self.stats_collector = stats_collector  # TrainingStatsCollector
+        self.stats = ResilienceStats()
+        self.events: List[RecoveryEvent] = []
+        self._preempt_requested = False
+        self._last_good: Optional[str] = None
+        self._lr_scale0 = getattr(net, "_lr_scale", 1.0)
+        os.makedirs(config.checkpoint_dir, exist_ok=True)
+
+    # --------------------------------------------------------------- events
+    def _emit(self, kind: str, step: int, detail: str = "",
+              counter: Optional[str] = None):
+        ev = RecoveryEvent(kind, step, detail)
+        self.events.append(ev)
+        if counter:
+            self.stats.bump(counter)
+        logger.info("resilience %s", ev)
+        for l in getattr(self.net, "listeners", ()):
+            on_recovery = getattr(l, "on_recovery", None)
+            if on_recovery is not None:
+                on_recovery(self.net, ev)
+        return ev
+
+    # ----------------------------------------------------------- checkpoint
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.config.checkpoint_dir, f"step_{step}")
+
+    def _checkpoint(self, step: int, reason: str) -> str:
+        from deeplearning4j_tpu.utils.checkpoint import save_checkpoint
+        path = self._step_dir(step)
+        save_checkpoint(self.net, path, stats=self.stats_collector)
+        # atomic latest-pointer: observers (and a quick resume fast path)
+        # read one small file; the rename is the commit point, so the
+        # pointer never names a half-written checkpoint
+        tmp = os.path.join(self.config.checkpoint_dir,
+                           "." + _LATEST_POINTER + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(path))
+        os.replace(tmp, os.path.join(self.config.checkpoint_dir,
+                                     _LATEST_POINTER))
+        self._last_good = path
+        self._emit("checkpoint", step, f"{reason} -> {path}",
+                   counter="checkpoints")
+        self._gc(step)
+        return path
+
+    def _gc(self, current_step: int):
+        """Retention: keep the newest ``keep_checkpoints`` valid steps;
+        also sweep partial saves older than the latest valid one (they
+        can never be resumed from and would otherwise accumulate one per
+        crash)."""
+        from deeplearning4j_tpu.utils.checkpoint import (_STEP_DIR,
+                                                         is_valid_checkpoint)
+        root = self.config.checkpoint_dir
+        entries = []
+        for name in os.listdir(root):
+            m = _STEP_DIR.match(name)
+            if m:
+                entries.append((int(m.group(1)), os.path.join(root, name)))
+        entries.sort()
+        valid = [(s, p) for s, p in entries if is_valid_checkpoint(p)]
+        keep = {p for _, p in valid[-max(1, self.config.keep_checkpoints):]}
+        newest_valid = valid[-1][0] if valid else -1
+        removed = 0
+        for step, path in entries:
+            partial = not is_valid_checkpoint(path)
+            if path in keep or (partial and step >= newest_valid):
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed += 1
+        if removed:
+            self.stats.bump("gc_removed", removed)
+            self._emit("gc", current_step,
+                       f"removed {removed} old/partial checkpoint(s)")
+
+    def _load_into(self, path: str):
+        """Restore ``path`` INTO the existing net object (params, state,
+        optimizer state, step/epoch counters) so user references stay
+        valid; the compiled step is shape-compatible and is reused."""
+        from deeplearning4j_tpu.utils.checkpoint import (
+            _net_kind, restore_computation_graph,
+            restore_multi_layer_network)
+        if _net_kind(self.net) == "graph":
+            restored = restore_computation_graph(path)
+        else:
+            restored = restore_multi_layer_network(path)
+        net = self.net
+        net.params = restored.params
+        net.state = restored.state
+        net.opt_state = restored.opt_state
+        net.iteration = restored.iteration
+        net.epoch = restored.epoch
+        self._last_good = path
+
+    # ------------------------------------------------------------- stepping
+    def request_preemption(self):
+        """Ask for a clean stop at the next step boundary (what the
+        SIGTERM handler calls; tests and the fault injector call it
+        directly)."""
+        self._preempt_requested = True
+
+    def _sigterm(self, signum, frame):
+        logger.warning("SIGTERM received — will checkpoint and exit at "
+                       "the next step boundary")
+        self.request_preemption()
+
+    def _attempt_step(self, ds, step: int):
+        """One fit_batch with transient-failure retry + exponential
+        backoff. The injector's before_step hook runs inside the retried
+        region so injected transient faults exercise this exact path."""
+        cfg = self.config
+        delay = cfg.backoff_initial_s
+        attempt = 0
+        while True:
+            try:
+                if self.injector is not None:
+                    self.injector.before_step(self, self.net, step)
+                return self.net.fit_batch(ds)
+            except cfg.retry_on as e:
+                attempt += 1
+                if attempt > cfg.max_step_retries:
+                    raise
+                self._emit(
+                    "retry", step,
+                    f"attempt {attempt}/{cfg.max_step_retries} after "
+                    f"{type(e).__name__}: {e}; backoff {delay:.3f}s",
+                    counter="retries")
+                cfg.sleep_fn(delay)
+                delay = min(delay * cfg.backoff_factor, cfg.backoff_max_s)
+
+    def _rollback(self, step: int, score: float, rollbacks: int):
+        cfg = self.config
+        if rollbacks > cfg.max_nan_rollbacks:
+            raise TrainingDivergedError(
+                f"loss is non-finite ({score}) at step {step} even after "
+                f"{cfg.max_nan_rollbacks} rollback(s) with LR backoff "
+                f"x{cfg.nan_lr_backoff} each — giving up rather than "
+                "checkpointing poisoned parameters")
+        if self._last_good is None:
+            raise TrainingDivergedError(
+                f"loss is non-finite ({score}) at step {step} and no good "
+                "checkpoint exists to roll back to")
+        new_scale = getattr(self.net, "_lr_scale", 1.0) * cfg.nan_lr_backoff
+        self._load_into(self._last_good)
+        if hasattr(self.net, "set_lr_scale"):
+            self.net.set_lr_scale(new_scale)
+        self._emit("rollback", self.net.iteration,
+                   f"non-finite loss ({score}) at step {step}; restored "
+                   f"{self._last_good}, lr scale now {new_scale:g}",
+                   counter="rollbacks")
+
+    # ------------------------------------------------------------ main loop
+    def run(self, batch_fn: Callable[[int], object],
+            target_step: int) -> SupervisorResult:
+        """Train until ``net.iteration == target_step`` feeding
+        ``batch_fn(step)`` at each step. Resumable: relaunching with the
+        same arguments continues from the newest valid checkpoint to the
+        same final step."""
+        from deeplearning4j_tpu.utils.checkpoint import (
+            find_latest_checkpoint)
+        cfg = self.config
+        net = self.net
+        resumed_from = None
+
+        if cfg.resume:
+            latest = find_latest_checkpoint(cfg.checkpoint_dir)
+            if latest is not None:
+                self._load_into(latest)
+                self._emit("resume", net.iteration, f"restored {latest}",
+                           counter="resumes")
+                resumed_from = latest
+
+        old_handler = None
+        use_signal = (cfg.handle_sigterm
+                      and threading.current_thread()
+                      is threading.main_thread())
+        if use_signal:
+            old_handler = signal.signal(signal.SIGTERM, self._sigterm)
+        try:
+            if self._last_good is None and net.iteration < target_step:
+                # baseline save: the NaN sentinel needs a rollback target
+                # from the very first step, and a crash before the first
+                # periodic save must not lose the (possibly expensive)
+                # initialization
+                self._checkpoint(net.iteration, "baseline")
+
+            rollbacks = 0
+            status = "completed"
+            while net.iteration < target_step:
+                if self._preempt_requested:
+                    status = "preempted"
+                    break
+                step = net.iteration
+                score = self._attempt_step(batch_fn(step), step)
+                check = (cfg.nan_check_every > 0
+                         and net.iteration % cfg.nan_check_every == 0)
+                if check and not math.isfinite(float(score)):
+                    rollbacks += 1
+                    self._rollback(step, float(score), rollbacks)
+                    continue
+                if (net.iteration % cfg.checkpoint_every_steps == 0
+                        and net.iteration < target_step):
+                    self._checkpoint(net.iteration, "periodic")
+
+            if status == "preempted":
+                self._checkpoint(net.iteration, "preemption")
+                self._emit("preempt", net.iteration,
+                           f"clean exit at step {net.iteration} of "
+                           f"{target_step}", counter="preemptions")
+            elif self._last_good != self._step_dir(net.iteration):
+                self._checkpoint(net.iteration, "final")
+        finally:
+            if use_signal:
+                signal.signal(signal.SIGTERM, old_handler)
+
+        return SupervisorResult(
+            status=status, final_step=net.iteration,
+            resumed_from=resumed_from, events=list(self.events),
+            stats=self.stats.snapshot())
+
+    # ----------------------------------------------------------- fit facade
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 32) -> SupervisorResult:
+        """The ``fit``-shaped entry: materializes the batch sequence and
+        supervises to the absolute step ``epochs * len(batches)`` —
+        absolute so a killed-and-relaunched run lands on the SAME final
+        step count as an uninterrupted one."""
+        batches = _materialize_batches(data, labels, batch_size)
+        if not batches:
+            raise ValueError("no training batches")
+        target = epochs * len(batches)
+        return self.run(lambda step: batches[step % len(batches)], target)
+
+
+def _materialize_batches(data, labels, batch_size):
+    """(data, labels) | DataSet | MultiDataSet | iterator -> list of
+    batches. Materialized so batch_fn(step) is deterministic across
+    restarts (resumability beats streaming here; for out-of-core data
+    pass a deterministic batch_fn to run() directly)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+    from deeplearning4j_tpu.datasets.iterator import (ArrayDataSetIterator,
+                                                      DataSetIterator)
+    if isinstance(data, (DataSet, MultiDataSet)):
+        return [data]
+    if isinstance(data, DataSetIterator):
+        batches = list(data)
+        data.reset()
+        return batches
+    return list(ArrayDataSetIterator(data, labels, batch_size=batch_size))
+
+
+def resilient_fit(net, data, labels=None, *, checkpoint_dir: str,
+                  epochs: int = 1, batch_size: int = 32, injector=None,
+                  stats_collector=None, **config_kw) -> SupervisorResult:
+    """One-call supervised training: ``resilient_fit(net, x, y,
+    checkpoint_dir=...)`` trains with checkpoint/resume, retry, NaN
+    rollback and preemption handling. ``config_kw`` feeds
+    SupervisorConfig (checkpoint_every_steps, keep_checkpoints, ...)."""
+    cfg = SupervisorConfig(checkpoint_dir=checkpoint_dir, **config_kw)
+    sup = TrainingSupervisor(net, cfg, injector=injector,
+                             stats_collector=stats_collector)
+    return sup.fit(data, labels, epochs=epochs, batch_size=batch_size)

@@ -12,9 +12,11 @@ bounded-queue backpressure, warm-up precompile, and ``/metrics``
 observability (serving/metrics.py). See SERVING.md.
 """
 
-from deeplearning4j_tpu.serving.batcher import MicroBatcher, QueueFullError
+from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
+                                                MicroBatcher, QueueFullError)
 from deeplearning4j_tpu.serving.metrics import ServingStats
-from deeplearning4j_tpu.serving.server import ModelServer, serve
+from deeplearning4j_tpu.serving.server import (DeadlineExceededError,
+                                               ModelServer, serve)
 
 __all__ = ["ModelServer", "serve", "MicroBatcher", "QueueFullError",
-           "ServingStats"]
+           "BatcherDeadError", "DeadlineExceededError", "ServingStats"]

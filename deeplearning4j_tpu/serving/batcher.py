@@ -45,6 +45,13 @@ class QueueFullError(RuntimeError):
     """Admission control: the pending-ticket queue is at ``max_queue``."""
 
 
+class BatcherDeadError(RuntimeError):
+    """The device thread died on an unexpected (non-request) error. The
+    server maps this to 503 + an unhealthy ``/healthz`` — a dead batcher
+    must look down to the load balancer, not hang every request until
+    its deadline."""
+
+
 def next_bucket(n: int, max_batch: int, min_batch: int = 1) -> int:
     """Power-of-two bucket, capped at ``max_batch``. Requests larger than
     ``max_batch`` are CHUNKED by the caller (never compiled at raw size —
@@ -94,8 +101,21 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._thread = None
         self._stopping = False
+        self._crashed = False
         if stats is not None:
             stats.queue_depth_fn = lambda: len(self._pending)
+
+    @property
+    def healthy(self) -> bool:
+        """False once the device thread has died (crashed on a
+        non-request error, or exited while not stopping) — the liveness
+        signal ``/healthz`` reports."""
+        if self._crashed:
+            return False
+        if (self._thread is not None and not self._thread.is_alive()
+                and not self._stopping):
+            return False
+        return True
 
     # ---------------------------------------------------------------- warmup
     def warm(self, row_shapes) -> list[int]:
@@ -148,6 +168,8 @@ class MicroBatcher:
         key = tuple(tuple(f.shape[1:]) for f in feats)
         t = _Ticket(feats, rows, key)
         with self._cond:
+            if not self.healthy:
+                raise BatcherDeadError("device thread is dead")
             if self._stopping:
                 raise RuntimeError("batcher is stopped")
             if len(self._pending) >= self.max_queue:
@@ -195,14 +217,37 @@ class MicroBatcher:
         return batch, rows
 
     def _loop(self):
-        while True:
-            with self._cond:
-                while not self._pending and not self._stopping:
-                    self._cond.wait()
-                if not self._pending:
-                    return  # stopping and fully drained
-                batch, rows = self._gather_locked()
-            self._execute(batch, rows)
+        batch = None
+        try:
+            while True:
+                with self._cond:
+                    while not self._pending and not self._stopping:
+                        self._cond.wait()
+                    if not self._pending:
+                        return  # stopping and fully drained
+                    batch, rows = self._gather_locked()
+                self._execute(batch, rows)
+                batch = None
+        except BaseException as e:  # noqa: BLE001 — device thread death
+            # _execute already absorbs per-request Exceptions; anything
+            # that reaches here (SystemExit, MemoryError, a bug) kills
+            # the device thread. Mark unhealthy and fail every waiting
+            # ticket NOW — futures must never hang until their deadline
+            # on a thread that will never run again.
+            self._die(batch, e)
+
+    def _die(self, batch, exc):
+        with self._cond:
+            self._crashed = True
+            stranded = list(self._pending)
+            self._pending.clear()
+        err = BatcherDeadError(
+            f"device thread died: {type(exc).__name__}: {exc}")
+        for t in list(batch or ()) + stranded:
+            if not t.future.done():
+                if self.stats is not None:
+                    self.stats.record_error()
+                t.future.set_exception(err)
 
     def _execute(self, batch, rows):
         n_inputs = len(batch[0].feats)

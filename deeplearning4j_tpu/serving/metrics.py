@@ -40,6 +40,7 @@ class ServingStats:
         self.batch_requests = 0             # tickets over those forwards
         self.rejected = 0                   # 503 admission rejections
         self.errors = 0                     # 400 request failures
+        self.timeouts = 0                   # 504 per-request deadline expiries
         self.batch_hist: dict[int, int] = {}  # executed bucket -> count
         self.queue_depth_fn = lambda: 0     # wired by the dispatcher
 
@@ -67,6 +68,10 @@ class ServingStats:
         with self._lock:
             self.errors += 1
 
+    def record_timeout(self):
+        with self._lock:
+            self.timeouts += 1
+
     # ------------------------------------------------------------- reporting
     def _percentiles(self, lats, qs):
         if not lats:
@@ -91,6 +96,7 @@ class ServingStats:
                 "batches_total": batches,
                 "rejected_total": self.rejected,
                 "errors_total": self.errors,
+                "timeouts_total": self.timeouts,
                 "queue_depth": int(self.queue_depth_fn()),
                 "latency_ms": self._percentiles(lats, (0.50, 0.95, 0.99)),
                 "latency_window": n,

@@ -35,15 +35,22 @@ from __future__ import annotations
 
 import json
 import time
+from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from deeplearning4j_tpu.serving.batcher import (MicroBatcher, QueueFullError,
+from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
+                                                MicroBatcher, QueueFullError,
                                                 next_bucket)
 from deeplearning4j_tpu.serving.metrics import ServingStats
 
 _next_bucket = next_bucket  # back-compat alias (seed name)
+
+
+class DeadlineExceededError(RuntimeError):
+    """The per-request deadline (``request_timeout_s``) expired before
+    the device produced a result — mapped to HTTP 504."""
 
 
 class _ServingHTTPServer(ThreadingHTTPServer):
@@ -56,13 +63,14 @@ class ModelServer:
     def __init__(self, net, host: str = "127.0.0.1", port: int = 9500,
                  max_batch: int = 1024, batch_window_ms: float = 2.0,
                  max_queue: int = 1024, warmup: bool = True,
-                 input_shapes=None):
+                 input_shapes=None, request_timeout_s: float = 300.0):
         self.net = net
         self.host = host
         self.port = port
         self.max_batch = max_batch
         self.warmup = warmup
         self.input_shapes = input_shapes
+        self.request_timeout_s = float(request_timeout_s)
         self._httpd = None
         self._thread = None
         self._is_graph = hasattr(net, "conf") and hasattr(
@@ -159,7 +167,19 @@ class ModelServer:
         futures = [self._batcher.submit(
                        [f[i:i + self.max_batch] for f in feats])
                    for i in range(0, max(n, 1), self.max_batch)]
-        chunks = [f.result(timeout=300) for f in futures]
+        # one deadline for the whole request, not per chunk: the budget
+        # left after chunk k is what chunk k+1 may spend
+        deadline = t0 + self.request_timeout_s
+        chunks = []
+        for f in futures:
+            try:
+                chunks.append(f.result(
+                    timeout=max(0.0, deadline - time.perf_counter())))
+            except _FutureTimeout:
+                self.stats.record_timeout()
+                raise DeadlineExceededError(
+                    f"request exceeded {self.request_timeout_s:g}s "
+                    "deadline") from None
         if isinstance(chunks[0], list):
             out = [np.concatenate([c[k] for c in chunks])
                    if len(chunks) > 1 else chunks[0][k]
@@ -209,6 +229,14 @@ class ModelServer:
 
             def do_GET(self):  # noqa: N802
                 if self.path.startswith("/healthz"):
+                    if not server._batcher.healthy:
+                        # a dead device thread means every /predict would
+                        # hang or 503 — report down so the load balancer
+                        # stops routing here
+                        self._json({"status": "unhealthy",
+                                    "reason": "batcher device thread dead"},
+                                   503)
+                        return
                     self._json({"status": "ok",
                                 "params": int(server.net.num_params()),
                                 "graph": server._is_graph})
@@ -238,6 +266,11 @@ class ModelServer:
                     # backpressure: shed load instead of growing the queue
                     self._json({"error": f"overloaded: {e}"}, 503,
                                headers=(("Retry-After", "1"),))
+                except BatcherDeadError as e:
+                    # dead device thread: same 503 the health check gives
+                    self._json({"error": f"unhealthy: {e}"}, 503)
+                except DeadlineExceededError as e:
+                    self._json({"error": str(e)}, 504)
                 except Exception as e:  # surface as a 400, keep serving
                     server.stats.record_error()
                     self._json({"error": f"{type(e).__name__}: {e}"}, 400)
@@ -271,8 +304,9 @@ class ModelServer:
 def serve(net, host: str = "127.0.0.1", port: int = 9500,
           max_batch: int = 1024, batch_window_ms: float = 2.0,
           max_queue: int = 1024, warmup: bool = True,
-          input_shapes=None) -> ModelServer:
+          input_shapes=None, request_timeout_s: float = 300.0) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
-                       warmup=warmup, input_shapes=input_shapes).start()
+                       warmup=warmup, input_shapes=input_shapes,
+                       request_timeout_s=request_timeout_s).start()

@@ -25,12 +25,30 @@ from __future__ import annotations
 
 import json
 import os
+import re
 
 import jax
 import numpy as np
 
 
+class CheckpointError(RuntimeError):
+    """Base error for checkpoint discovery/restore failures."""
+
+
+class IncompleteCheckpointError(CheckpointError):
+    """Restore hit a partial save (tree committed, ``meta.json`` never
+    renamed in) — the footprint a crash between the two commits leaves.
+    Names the offending directory instead of surfacing a raw orbax
+    traceback; ``find_latest_checkpoint`` skips such directories."""
+
+
 _CKPTR = None
+
+# Fault-injection seam: called between the (atomic) orbax tree commit and
+# the meta.json rename — the exact window a real preemption can hit.
+# resilience/faultinject.py installs a crasher here so the partial-save
+# recovery path is exercised by tests instead of hoped for.
+_POST_COMMIT_HOOK = None
 
 
 def _checkpointer():
@@ -76,6 +94,8 @@ def _save_checkpoint_inner(net, path: str):
             "opt_state": net.opt_state}
     ckptr.save(os.path.join(path, "tree"), tree, force=True)
     ckptr.wait_until_finished()
+    if _POST_COMMIT_HOOK is not None:
+        _POST_COMMIT_HOOK(path)
     if jax.process_index() == 0:
         meta = {
             "kind": _net_kind(net),
@@ -98,11 +118,50 @@ def _save_checkpoint_inner(net, path: str):
     return path
 
 
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def is_valid_checkpoint(path: str) -> bool:
+    """A complete save: the orbax tree directory AND ``meta.json`` (which
+    lands via rename strictly after the tree commit, so its presence
+    certifies the whole checkpoint)."""
+    return (os.path.isdir(os.path.join(path, "tree"))
+            and os.path.isfile(os.path.join(path, "meta.json")))
+
+
+def find_latest_checkpoint(directory: str):
+    """Newest *valid* ``step_<n>`` checkpoint under ``directory``, or None.
+
+    Partial saves (a crash between the tree commit and the meta.json
+    rename leaves a step directory with no meta.json) are skipped — the
+    auto-resume contract is "newest checkpoint that is provably
+    complete", never "newest directory". Ordering is by step number, not
+    mtime: a rolled-back run may legitimately rewrite an older step
+    later."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        m = _STEP_DIR.match(name)
+        if m is None:
+            continue
+        path = os.path.join(directory, name)
+        if int(m.group(1)) > best_step and is_valid_checkpoint(path):
+            best, best_step = path, int(m.group(1))
+    return best
+
+
 def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data",
              model_axis=None, tp_rules=None):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    if not os.path.isfile(os.path.join(path, "meta.json")):
+        raise IncompleteCheckpointError(
+            f"partial checkpoint at {path}: meta.json is missing (a save "
+            "was interrupted between the tree commit and the meta rename)."
+            " Resume from the previous step directory — "
+            "find_latest_checkpoint() skips partial saves automatically")
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     if meta["kind"] != expect_kind:

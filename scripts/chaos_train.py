@@ -1,0 +1,191 @@
+"""Chaos training demo: the fault-tolerant runtime survives a hostile
+schedule of injected failures and still lands on BIT-IDENTICAL final
+parameters vs an uninterrupted run.
+
+Drives resilience/supervisor.py end to end through a relaunch loop:
+
+1. **Reference** — train the MNIST-shaped MLP ``--steps`` steps with a
+   plain ``fit_batch`` loop, no supervisor.
+2. **Chaos** — train the same net/data/step-count under the supervisor,
+   but keep killing it: each launch arms ONE fault from a deterministic
+   schedule (crash between the checkpoint tree commit and its
+   ``meta.json`` rename, transient step exceptions retried with backoff,
+   SIGTERM-style preemption), then relaunches with a FRESH net object —
+   resume must come entirely from disk, exactly like a new process.
+3. **Verdict** — every parameter array of the chaos survivor is compared
+   bit-for-bit against the reference (``np.testing.assert_array_equal``,
+   not allclose): recovery that perturbed the trajectory would not count.
+
+The net is dropout-free and seed-fixed, so the step sequence is
+deterministic given the step counter — which is exactly what the
+supervisor checkpoints and restores.
+
+Run: ``python scripts/chaos_train.py`` (CPU is fine, ~20s). The slow
+pytest variant of this loop is
+``tests/test_resilience.py::test_composite_chaos_run_slow``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # F64 policy, like the tests
+
+
+def build_net(seed):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    f64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .dtype(f64).list()
+            .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_batches(seed, batch_size, n_batches=4):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(seed)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch_size, 12))
+        y = np.eye(4)[rng.integers(0, 4, batch_size)]
+        batches.append(DataSet(x, y))
+    return batches
+
+
+def flat_params(net):
+    return {(n, k): np.asarray(v) for n, sub in net.params.items()
+            for k, v in sub.items()}
+
+
+def chaos_schedule(steps):
+    """Faults armed per launch (a launch survives transients in place but
+    dies to save-crashes and stops for preemptions, so every launch
+    except the last ends early). Deterministic, so reruns of this
+    script behave identically."""
+    return [
+        [("crash_save", 1)],                        # kill the 2nd save
+        [("transient", max(2, steps // 3)),         # retried in-place...
+         ("preempt", max(3, steps // 2))],          # ...then clean stop
+        [("crash_save", 1)],                        # kill a save again
+        [],                                         # clean final launch
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--steps", type=int, default=60,
+                    help="absolute target step count (default 60)")
+    ap.add_argument("--checkpoint-every", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--keep", type=int, default=3,
+                    help="checkpoint retention (default 3)")
+    ap.add_argument("--dir", default=None,
+                    help="checkpoint directory (default: fresh tempdir)")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.resilience import (FaultInjector, InjectedCrash,
+                                               SupervisorConfig,
+                                               TrainingSupervisor)
+
+    ckpt_dir = args.dir or tempfile.mkdtemp(prefix="chaos_train_")
+    if args.dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+    batches = build_batches(args.seed, args.batch_size)
+    batch_fn = lambda step: batches[step % len(batches)]  # noqa: E731
+
+    # ------------------------------------------------ 1. reference run
+    print(f"[reference] {args.steps} uninterrupted steps ...")
+    t0 = time.perf_counter()
+    ref = build_net(args.seed)
+    for step in range(args.steps):
+        ref.fit_batch(batch_fn(step))
+    print(f"[reference] done in {time.perf_counter() - t0:.1f}s "
+          f"(final score {float(ref.score_value):.4f})")
+
+    # ---------------------------------------------------- 2. chaos run
+    schedule = chaos_schedule(args.steps)
+    n_faults = sum(len(launch) for launch in schedule)
+    print(f"\n[chaos] target step {args.steps}, checkpoint every "
+          f"{args.checkpoint_every}, dir {ckpt_dir}")
+    launches, net, result = 0, None, None
+    totals = {}
+    while True:
+        launches += 1
+        injector = FaultInjector()
+        for fault, at in schedule[min(launches - 1, len(schedule) - 1)]:
+            if fault == "crash_save":
+                injector.crash_during_save(at)
+            elif fault == "transient":
+                injector.fail_step(at, times=2)
+            elif fault == "preempt":
+                injector.preempt_at_step(at)
+
+        net = build_net(args.seed)  # fresh object: resume is disk-only
+        sup = TrainingSupervisor(
+            net,
+            SupervisorConfig(checkpoint_dir=ckpt_dir,
+                             checkpoint_every_steps=args.checkpoint_every,
+                             keep_checkpoints=args.keep,
+                             backoff_initial_s=0.01,
+                             handle_sigterm=False),
+            injector=injector)
+        try:
+            with injector.installed():
+                result = sup.run(batch_fn, args.steps)
+        except InjectedCrash as e:
+            print(f"[chaos] launch {launches}: KILLED mid-save ({e}) at "
+                  f"step {net.iteration} — relaunching")
+            for k, v in sup.stats.snapshot().items():
+                totals[k] = totals.get(k, 0) + v
+            continue
+        for k, v in result.stats.items():
+            totals[k] = totals.get(k, 0) + v
+        if result.status == "preempted":
+            print(f"[chaos] launch {launches}: preempted cleanly at step "
+                  f"{result.final_step} — relaunching")
+            continue
+        print(f"[chaos] launch {launches}: completed at step "
+              f"{result.final_step}"
+              + (f" (resumed from {os.path.basename(result.resumed_from)})"
+                 if result.resumed_from else ""))
+        break
+
+    # ------------------------------------------------------ 3. verdict
+    assert result.final_step == args.steps, (result.final_step, args.steps)
+    pr, pc = flat_params(ref), flat_params(net)
+    assert pr.keys() == pc.keys()
+    for key in pr:
+        np.testing.assert_array_equal(pr[key], pc[key],
+                                      err_msg=f"param {key} diverged")
+
+    print(f"\n[verdict] PASS — {launches} launches "
+          f"({n_faults} injected faults), final step "
+          f"{result.final_step}, all {len(pr)} parameter arrays "
+          "BIT-IDENTICAL to the uninterrupted run")
+    print("[stats]  " + "  ".join(f"{k}={v}" for k, v in sorted(
+        totals.items()) if v))
+    if not args.dir:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

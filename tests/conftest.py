@@ -34,6 +34,13 @@ if not _TPU_MODE:
 _TPU_MODULES = {"test_backend_equivalence.py", "test_tpu_numerics.py"}
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long end-to-end runs (chaos training, full recovery "
+        "matrices) excluded from the tier-1 `-m 'not slow'` sweep")
+
+
 def pytest_collection_modifyitems(config, items):
     if not _TPU_MODE:
         return

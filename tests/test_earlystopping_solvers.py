@@ -124,6 +124,36 @@ def test_local_file_saver_round_trip(tmp_path):
     assert np.asarray(best.output(x[:4])).shape == (4, 3)
 
 
+def test_local_file_saver_crash_mid_save_keeps_previous(tmp_path,
+                                                        monkeypatch):
+    """Atomic temp-write+rename: a crash mid-save must never corrupt the
+    existing bestModel.zip — the previous complete model stays
+    restorable (resilience satellite; before this, a half-written zip
+    clobbered the best model in place)."""
+    x, y = make_problem()
+    net = make_net()
+    net.fit_batch(DataSet(x, y))
+    saver = LocalFileModelSaver(str(tmp_path))
+    saver.save_best(net)
+    expect = np.asarray(saver.get_best().output(x[:4]))
+
+    def crashing_write(n, path, *a, **kw):
+        with open(path, "wb") as f:
+            f.write(b"partial garbage")  # half-written zip...
+        raise RuntimeError("injected crash mid-serialization")
+
+    monkeypatch.setattr("deeplearning4j_tpu.utils.serialization.write_model",
+                        crashing_write)
+    net.fit_batch(DataSet(x, y))
+    with pytest.raises(RuntimeError, match="injected crash"):
+        saver.save_best(net)
+    # the garbage went to the temp file (now cleaned up); the previous
+    # complete model is untouched and still loads
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["bestModel.zip"]
+    np.testing.assert_array_equal(
+        np.asarray(saver.get_best().output(x[:4])), expect)
+
+
 # ----------------------------------------------------------------- solvers
 @pytest.mark.parametrize("cls", [LineGradientDescent, ConjugateGradient, LBFGS])
 def test_solver_reduces_loss(cls):

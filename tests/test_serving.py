@@ -340,6 +340,94 @@ def test_serve_queue_overflow_returns_503_then_recovers():
         server.stop()
 
 
+def test_serve_request_deadline_returns_504_then_recovers():
+    """Per-request deadline (configurable, replaces the hard-coded 300s):
+    a stalled device answers 504 within the budget; once the device
+    frees up the server serves normally and /metrics counted the
+    timeout."""
+    import threading
+
+    net = _mlp()
+    server = serve(net, port=0, warmup=False, request_timeout_s=0.5)
+    gate = threading.Event()
+    real_forward = server._device_forward
+    stall = [0]  # armed after the compile-warming request
+
+    def slow_forward(feats):
+        if stall[0] > 0:
+            stall[0] -= 1
+            gate.wait(timeout=60)
+        return real_forward(feats)
+
+    server._batcher._forward = slow_forward
+    x = np.zeros((1, 4))
+    try:
+        # warm the compile first so the deadline measures the stall, not
+        # the first-compile cost
+        _post(server.url + "/predict", {"features": x.tolist()})
+        stall[0] = 1
+        try:
+            _post(server.url + "/predict", {"features": x.tolist()})
+            assert False, "expected 504"
+        except urllib.error.HTTPError as e:
+            assert e.code == 504
+        gate.set()
+        got = _post(server.url + "/predict", {"features": x.tolist()})
+        assert np.asarray(got["predictions"]).shape == (1, 3)
+        m = _get(server.url + "/metrics")
+        assert m["timeouts_total"] == 1
+    finally:
+        gate.set()
+        server.stop()
+
+
+def test_serve_dead_batcher_thread_unhealthy_503():
+    """A dead device thread (a non-request error killed the batcher
+    loop) must flip /healthz to 503/unhealthy and make /predict answer
+    503 — not hang every request until its deadline."""
+    net = _mlp()
+    server = serve(net, port=0, warmup=False, request_timeout_s=30)
+    real_forward = server._device_forward
+    kill = [1]
+
+    def dying_forward(feats):
+        if kill[0] > 0:
+            kill[0] -= 1
+            # BaseException: escapes the per-batch Exception handler,
+            # exactly like an OOM/abort tearing down the device thread
+            raise SystemExit("simulated device thread death")
+        return real_forward(feats)
+
+    server._batcher._forward = dying_forward
+    x = np.zeros((1, 4))
+    try:
+        # healthy before the fault
+        with urllib.request.urlopen(server.url + "/healthz", timeout=30) as r:
+            assert json.loads(r.read().decode())["status"] == "ok"
+        # the killing request is failed fast (503), not left hanging
+        try:
+            _post(server.url + "/predict", {"features": x.tolist()})
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # health reports down
+        try:
+            urllib.request.urlopen(server.url + "/healthz", timeout=30)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read().decode())["status"] == "unhealthy"
+        # subsequent predicts shed immediately with 503 too
+        try:
+            _post(server.url + "/predict", {"features": x.tolist()})
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        assert not server._batcher.healthy
+    finally:
+        server.stop()
+
+
 def test_serve_graph_multi_input_coalesces_by_arity_group():
     """Graph traffic: same-shape multi-input requests coalesce; the
     batcher groups by per-input row shapes so replies stay row-exact."""
