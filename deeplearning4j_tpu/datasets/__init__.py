@@ -7,6 +7,7 @@ from deeplearning4j_tpu.datasets.iterator import (
     ListDataSetIterator,
     ArrayDataSetIterator,
     AsyncDataSetIterator,
+    DevicePrefetchIterator,
     MultipleEpochsIterator,
     SamplingDataSetIterator,
     ReconstructionDataSetIterator,

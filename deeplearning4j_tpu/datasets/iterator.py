@@ -10,13 +10,20 @@ TPU equivalent of the reference's host I/O boundary.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Iterable, List, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+
+def default_prefetch_depth() -> int:
+    """Async prefetch queue depth (reference default 2; override with
+    DL4J_TPU_PREFETCH_DEPTH for slow input pipelines)."""
+    return max(1, int(os.environ.get("DL4J_TPU_PREFETCH_DEPTH", "2")))
 
 
 class DataSetIterator:
@@ -89,13 +96,22 @@ class ArrayDataSetIterator(DataSetIterator):
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch wrapper (AsyncDataSetIterator.java parity:
-    blocking queue, default depth 2)."""
+    blocking queue, default depth 2 — configurable per instance or via
+    DL4J_TPU_PREFETCH_DEPTH).
+
+    The consumer's ``finally`` drains the queue and JOINS the producer
+    thread, so abandoning the generator early (break, exception, a chaos
+    relaunch tearing down the fit loop) never leaks a prefetch thread
+    blocked on a full queue."""
 
     _SENTINEL = object()
+    THREAD_NAME = "dl4j-async-prefetch"
 
-    def __init__(self, base: DataSetIterator, queue_size: int = 2):
+    def __init__(self, base: DataSetIterator,
+                 queue_size: Optional[int] = None):
         self.base = base
-        self.queue_size = queue_size
+        self.queue_size = (default_prefetch_depth() if queue_size is None
+                           else max(1, int(queue_size)))
 
     def __iter__(self):
         q: queue.Queue = queue.Queue(maxsize=self.queue_size)
@@ -124,7 +140,8 @@ class AsyncDataSetIterator(DataSetIterator):
             finally:
                 put(self._SENTINEL)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name=self.THREAD_NAME)
         t.start()
         try:
             while True:
@@ -136,6 +153,74 @@ class AsyncDataSetIterator(DataSetIterator):
                 yield item
         finally:
             stop.set()
+            # Drain so a producer blocked on put() observes stop quickly,
+            # then join: no thread may outlive its consumer.
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Double-buffered host→device transfer: issues ``jax.device_put`` for
+    batch N+1 before yielding batch N, so the transfer rides under the
+    device compute of step N. ``device_put`` is asynchronous (it returns
+    a future-backed array immediately), so no extra thread is needed —
+    layering this on :class:`AsyncDataSetIterator` gives host prep AND
+    the PCIe/ICI copy both off the step's critical path. Yielded
+    DataSets hold committed device arrays, making the inline
+    ``jnp.asarray`` calls in ``fit_batch`` no-ops.
+
+    ``sharding`` (optional): a ``jax.sharding.Sharding`` applied to every
+    batch leaf — pass the net's data sharding when meshed so the arrays
+    land already distributed."""
+
+    def __init__(self, base: DataSetIterator, sharding=None):
+        self.base = base
+        self.sharding = sharding
+
+    def _put(self, arr):
+        if arr is None:
+            return None
+        import jax
+        if self.sharding is not None:
+            return jax.device_put(arr, self.sharding)
+        return jax.device_put(arr)
+
+    def _to_device(self, ds):
+        if isinstance(ds, MultiDataSet):
+            return MultiDataSet(
+                [self._put(f) for f in ds.features],
+                [self._put(l) for l in ds.labels],
+                (None if ds.features_masks is None
+                 else [self._put(m) for m in ds.features_masks]),
+                (None if ds.labels_masks is None
+                 else [self._put(m) for m in ds.labels_masks]),
+            )
+        return DataSet(
+            self._put(ds.features), self._put(ds.labels),
+            self._put(ds.features_mask), self._put(ds.labels_mask))
+
+    def __iter__(self):
+        it = iter(self.base)
+        try:
+            pending = self._to_device(next(it))
+        except StopIteration:
+            return
+        for ds in it:
+            nxt = self._to_device(ds)  # in flight while batch N computes
+            yield pending
+            pending = nxt
+        yield pending
 
     def reset(self):
         self.base.reset()

@@ -690,7 +690,9 @@ class ComputationGraph:
                     self.params, self.state, self.opt_state, it, inputs,
                     lab, fm, lm, rng)
                 w = sl.stop - sl.start
-                score_sum = score_sum + float(chunk_score) * w
+                # accumulate ON DEVICE: a float() here would sync the
+                # pipeline once per chunk; consumers pull the final mean
+                score_sum = score_sum + chunk_score * w
                 weight += w
             self.state = self._strip_carries(self.state)
             score = score_sum / max(weight, 1)
@@ -735,11 +737,19 @@ class ComputationGraph:
             l.iteration_done(self, self.iteration, self.epoch)
         return score
 
-    def fit(self, data, *, epochs: int = 1, async_prefetch: bool = True):
+    def fit(self, data, *, epochs: int = 1, async_prefetch: bool = True,
+            device_prefetch="auto", multi_step="auto"):
         """Train on an iterator of DataSet/MultiDataSet, or a single one.
         Iterators are wrapped in a background prefetch thread
         (AsyncDataSetIterator auto-wrap parity, MultiLayerNetwork.java:951 /
-        ComputationGraph.java:701)."""
+        ComputationGraph.java:701).
+
+        Async runtime (bit-identity-preserving, see
+        MultiLayerNetwork.fit): ``device_prefetch`` overlaps the
+        host→device copy of batch N+1 with step N ("auto" = accelerator
+        backends only); ``multi_step`` drives chunks of k steps through
+        one jitted scan when no attached listener needs per-iteration
+        values ("auto" = 8 on accelerators)."""
         if isinstance(data, (DataSet, MultiDataSet)):
             items = [data]
             for _ in range(epochs):
@@ -747,19 +757,145 @@ class ComputationGraph:
                     self.fit_batch(d)
                 self.epoch += 1
             return self
-        from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator
+        from deeplearning4j_tpu.datasets.iterator import (
+            AsyncDataSetIterator, DevicePrefetchIterator)
+        chunk = self._resolve_multi_step(multi_step)
+        device_prefetch = self._resolve_device_prefetch(device_prefetch)
         for _ in range(epochs):
             source = data
             if async_prefetch and hasattr(data, "reset"):
                 source = AsyncDataSetIterator(data)
-            for d in source:
-                self.fit_batch(d)
+            if device_prefetch:
+                source = DevicePrefetchIterator(
+                    source, sharding=self._prefetch_sharding())
+            if chunk > 1:
+                self._fit_epoch_chunked(source, chunk)
+            else:
+                for d in source:
+                    self.fit_batch(d)
             if hasattr(data, "reset"):
                 data.reset()
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
         return self
+
+    _FIT_CHUNK_DEFAULT = 8
+
+    def _resolve_multi_step(self, multi_step) -> int:
+        """How many fit steps one jitted dispatch may cover. 1 = per-batch
+        (mesh / tbptt / a listener that needs real per-step boundaries).
+        "auto" also resolves to 1 on the CPU backend — see
+        MultiLayerNetwork._resolve_multi_step; an explicit int is always
+        honored."""
+        if multi_step in (None, False, 0, 1):
+            return 1
+        if self._mesh is not None or self.conf.backprop_type == "tbptt":
+            return 1
+        for l in self.listeners:
+            if getattr(l, "needs_per_iteration", True):
+                return 1
+        if multi_step == "auto":
+            if jax.default_backend() == "cpu":
+                return 1
+            return self._FIT_CHUNK_DEFAULT
+        return max(1, int(multi_step))
+
+    @staticmethod
+    def _resolve_device_prefetch(device_prefetch) -> bool:
+        """"auto" = accelerator backends only — see
+        MultiLayerNetwork._resolve_device_prefetch."""
+        if device_prefetch == "auto":
+            return jax.default_backend() != "cpu"
+        return bool(device_prefetch)
+
+    def _prefetch_sharding(self):
+        """Target sharding for prefetched batches (None = default device);
+        multi-process meshes keep host batches for shard_step_multi."""
+        if self._mesh is None:
+            return None
+        if jax.process_count() > 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh, axis = self._mesh
+        return NamedSharding(mesh, PartitionSpec(axis))
+
+    def _fit_epoch_chunked(self, source, chunk: int):
+        """Group consecutive same-shape minibatches and dispatch each group
+        as ONE jitted scan over distinct batches (bit-identical to the
+        per-batch loop, including the rng chain — see multistep.py)."""
+        self._require_init()
+
+        def signature(m):
+            return (tuple(tuple(f.shape) for f in m.features),
+                    tuple(tuple(l.shape) for l in m.labels),
+                    tuple(None if x is None else tuple(x.shape)
+                          for x in m.features_masks),
+                    tuple(None if x is None else tuple(x.shape)
+                          for x in m.labels_masks))
+
+        buf, sig = [], None
+        for d in source:
+            m = self._coerce(d)
+            s = signature(m)
+            if buf and s != sig:
+                self._dispatch_chunk(buf)
+                buf = []
+            sig = s
+            buf.append(m)
+            if len(buf) == chunk:
+                self._dispatch_chunk(buf)
+                buf = []
+        if buf:
+            self._dispatch_chunk(buf)
+
+    def _dispatch_chunk(self, batches):
+        """Run len(batches) steps in one XLA execution (lax.scan over the
+        fused step), then replay listeners with per-iteration scores."""
+        if len(batches) == 1:
+            self.fit_batch(batches[0])
+            return
+        from deeplearning4j_tpu.nn.multistep import get_multi_batch_step
+        jitted = get_multi_batch_step(self)
+        prepared = [self._prepare_inputs(m.features, m.features_masks)
+                    for m in batches]
+        inputs = {n: jnp.stack([p[0][n] for p in prepared])
+                  for n in prepared[0][0]}
+        fmasks = {n: jnp.stack([p[1][n] for p in prepared])
+                  for n in prepared[0][1]}
+        labels = [jnp.stack([jnp.asarray(m.labels[i]) for m in batches])
+                  for i in range(len(batches[0].labels))]
+        lmasks = [None if batches[0].labels_masks[i] is None else
+                  jnp.stack([jnp.asarray(m.labels_masks[i])
+                             for m in batches])
+                  for i in range(len(batches[0].labels_masks))]
+        if all(m is None for m in lmasks):
+            lmasks = None
+        it0 = jnp.asarray(self.iteration, jnp.int32)
+        steps = jnp.arange(len(batches), dtype=jnp.int32)
+        (self.params, self.state, self.opt_state, self._rng_key,
+         scores) = jitted(self.params, self.state, self.opt_state, it0,
+                          self._rng_key, steps,
+                          (inputs, labels, fmasks, lmasks))
+        start = self.iteration
+        self.iteration += len(batches)
+        self.score_value = scores[-1]
+        self.last_batch_examples = batches[-1].num_examples
+        self._replay_listeners(start, scores,
+                               [m.num_examples for m in batches])
+
+    def _replay_listeners(self, start: int, scores, examples):
+        """Post-chunk iteration_done replay with per-iteration lazy score
+        slices (every listener here declared needs_per_iteration=False)."""
+        if not self.listeners:
+            return
+        for j in range(len(examples)):
+            self.score_value = scores[j]
+            self.last_batch_examples = examples[j]
+            for l in self.listeners:
+                l.iteration_done(self, start + j + 1, self.epoch)
+        self.score_value = scores[-1]
+        self.last_batch_examples = examples[-1]
 
     # ------------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1):

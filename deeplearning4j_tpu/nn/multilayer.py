@@ -26,6 +26,7 @@ from deeplearning4j_tpu.datasets.iterator import (
     ArrayDataSetIterator,
     AsyncDataSetIterator,
     DataSetIterator,
+    DevicePrefetchIterator,
     ListDataSetIterator,
 )
 from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
@@ -524,7 +525,9 @@ class MultiLayerNetwork:
                         None if lmask is None else lmask[:, sl],
                         rng)
                 w = sl.stop - sl.start
-                score_sum = score_sum + float(chunk_score) * w
+                # accumulate ON DEVICE: a float() here would sync the
+                # pipeline once per chunk; consumers pull the final mean
+                score_sum = score_sum + chunk_score * w
                 weight += w
             self.state = self._strip_carries(self.state)
             score = score_sum / max(weight, 1)
@@ -564,27 +567,156 @@ class MultiLayerNetwork:
         return score
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
-            async_prefetch: bool = True):
+            async_prefetch: bool = True, device_prefetch="auto",
+            multi_step="auto"):
         """Train. Accepts a DataSetIterator, a DataSet, or (features, labels)
         arrays (MultiLayerNetwork.fit overloads parity; iterator is wrapped
-        in an async prefetcher like MultiLayerNetwork.java:951)."""
+        in an async prefetcher like MultiLayerNetwork.java:951).
+
+        Async runtime (all bit-identity-preserving vs the per-batch loop):
+        ``async_prefetch`` overlaps host batch prep (background thread),
+        ``device_prefetch`` overlaps the host→device copy of batch N+1 with
+        step N (DevicePrefetchIterator; "auto" = on for accelerator
+        backends, off on CPU where there is no transfer to hide), and
+        ``multi_step`` collapses k Python dispatches into one jitted scan
+        chunk ("auto" = 8 on accelerators when no attached listener needs
+        per-iteration values; an int pins k; 1 disables). Chunking is
+        skipped under a device mesh and for tBPTT, where per-batch
+        semantics differ."""
         if isinstance(data, DataSetIterator):
             it = data
         elif isinstance(data, DataSet):
             it = ListDataSetIterator([data])
         else:
             it = ArrayDataSetIterator(data, labels, batch_size=batch_size)
+        chunk = self._resolve_multi_step(multi_step)
+        device_prefetch = self._resolve_device_prefetch(device_prefetch)
         for epoch in range(epochs):
             source = AsyncDataSetIterator(it) if async_prefetch else it
+            if device_prefetch:
+                source = DevicePrefetchIterator(
+                    source, sharding=self._prefetch_sharding())
             for l in self.listeners:
                 l.on_epoch_start(self)
-            for ds in source:
-                self.fit_batch(ds)
+            if chunk > 1:
+                self._fit_epoch_chunked(source, chunk)
+            else:
+                for ds in source:
+                    self.fit_batch(ds)
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
             it.reset()
         return self
+
+    _FIT_CHUNK_DEFAULT = 8
+
+    def _resolve_multi_step(self, multi_step) -> int:
+        """How many fit steps one jitted dispatch may cover. 1 = per-batch
+        (mesh / tbptt / a listener that needs real per-step boundaries).
+        "auto" also resolves to 1 on the CPU backend: collapsing dispatch
+        pays when per-step dispatch overhead rivals device compute
+        (accelerators); XLA:CPU instead pays scan-carry copies + chunk
+        slicing that dwarf the dispatch saved (measured in bench
+        host_loop). An explicit int is always honored."""
+        if multi_step in (None, False, 0, 1):
+            return 1
+        if self._mesh is not None or self.conf.backprop_type == "tbptt":
+            return 1
+        for l in self.listeners:
+            if getattr(l, "needs_per_iteration", True):
+                return 1
+        if multi_step == "auto":
+            if jax.default_backend() == "cpu":
+                return 1
+            return self._FIT_CHUNK_DEFAULT
+        return max(1, int(multi_step))
+
+    @staticmethod
+    def _resolve_device_prefetch(device_prefetch) -> bool:
+        """"auto" = on for accelerator backends (overlaps the host→device
+        copy of batch N+1 with step N); off on CPU, where device_put is
+        just an extra eager copy with no transfer to hide (measured in
+        bench host_loop). Explicit booleans are always honored."""
+        if device_prefetch == "auto":
+            return jax.default_backend() != "cpu"
+        return bool(device_prefetch)
+
+    def _prefetch_sharding(self):
+        """Target sharding for prefetched batches (None = default device).
+        Multi-process meshes assemble global arrays from host shards in
+        shard_step, so they keep host-side batches."""
+        if self._mesh is None:
+            return None
+        if jax.process_count() > 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh, axis = self._mesh
+        return NamedSharding(mesh, PartitionSpec(axis))
+
+    def _fit_epoch_chunked(self, source, chunk: int):
+        """Group consecutive same-shape batches and dispatch each group as
+        ONE jitted scan over distinct batches (bit-identical to the
+        per-batch loop, including the rng chain — see multistep.py)."""
+        self._require_init()
+        buf, sig = [], None
+        for ds in source:
+            s = (tuple(ds.features.shape), tuple(ds.labels.shape),
+                 None if ds.features_mask is None
+                 else tuple(ds.features_mask.shape),
+                 None if ds.labels_mask is None
+                 else tuple(ds.labels_mask.shape))
+            if buf and s != sig:
+                self._dispatch_chunk(buf)
+                buf = []
+            sig = s
+            buf.append(ds)
+            if len(buf) == chunk:
+                self._dispatch_chunk(buf)
+                buf = []
+        if buf:
+            self._dispatch_chunk(buf)
+
+    def _dispatch_chunk(self, batches):
+        """Run len(batches) steps in one XLA execution (lax.scan over the
+        fused step), then replay listeners with per-iteration scores."""
+        if len(batches) == 1:
+            self.fit_batch(batches[0])
+            return
+        from deeplearning4j_tpu.nn.multistep import get_multi_batch_step
+        jitted = get_multi_batch_step(self)
+        xs = jnp.stack([jnp.asarray(b.features) for b in batches])
+        ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        fmask = (None if batches[0].features_mask is None else
+                 jnp.stack([jnp.asarray(b.features_mask) for b in batches]))
+        lmask = (None if batches[0].labels_mask is None else
+                 jnp.stack([jnp.asarray(b.labels_mask) for b in batches]))
+        it0 = jnp.asarray(self.iteration, jnp.int32)
+        steps = jnp.arange(len(batches), dtype=jnp.int32)
+        (self.params, self.state, self.opt_state, self._rng_key,
+         scores) = jitted(self.params, self.state, self.opt_state, it0,
+                          self._rng_key, steps, (xs, ys, fmask, lmask))
+        start = self.iteration
+        self.iteration += len(batches)
+        self.score_value = scores[-1]
+        self.last_batch_examples = batches[-1].num_examples
+        self._replay_listeners(start, scores,
+                               [b.num_examples for b in batches])
+
+    def _replay_listeners(self, start: int, scores, examples):
+        """Post-chunk iteration_done replay: every listener here declared
+        needs_per_iteration=False, so it sees the same (iteration, score)
+        stream as per-batch dispatch — score_value stays a lazy device
+        slice until a listener's own cadence floats it."""
+        if not self.listeners:
+            return
+        for j in range(len(examples)):
+            self.score_value = scores[j]
+            self.last_batch_examples = examples[j]
+            for l in self.listeners:
+                l.iteration_done(self, start + j + 1, self.epoch)
+        self.score_value = scores[-1]
+        self.last_batch_examples = examples[-1]
 
     def resilient_fit(self, data, labels=None, *, checkpoint_dir: str,
                       epochs: int = 1, batch_size: int = 32, **supervisor_kw):

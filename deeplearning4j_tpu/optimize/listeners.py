@@ -7,7 +7,17 @@ CollectScoresIterationListener, ComposableIterationListener).
 Note: reading ``net.score_value`` forces a device sync; listeners that log
 every iteration therefore sample (print frequency) exactly like the
 reference, and PerformanceListener measures wall-clock between calls without
-forcing a sync unless reporting.
+forcing a sync unless reporting. ``score_value`` itself stays a lazy device
+array — only a listener's own cadence (or an explicit ``float()``) pulls it
+to the host.
+
+``needs_per_iteration`` (class attribute, default True): declares whether
+the listener's semantics depend on being invoked at the real wall-clock
+moment each iteration finishes (timing listeners, per-step param pulls).
+Listeners that only consume ``(iteration, score_value)`` pairs declare
+False; when every attached listener does, ``fit`` may dispatch several
+steps as one jitted scan chunk and REPLAY ``iteration_done`` per inner
+iteration afterwards with identical (iteration, score) values.
 """
 
 from __future__ import annotations
@@ -22,6 +32,11 @@ class TrainingListener:
     """Base listener (TrainingListener.java parity: onEpochStart/End,
     iterationDone; forward/backward hooks are meaningless inside one fused
     XLA step, so they are not exposed)."""
+
+    # True = must run at the real per-step boundary (timings, param pulls);
+    # False = only consumes (iteration, score) and tolerates chunked
+    # dispatch with post-hoc replay (see module docstring).
+    needs_per_iteration = True
 
     def iteration_done(self, net, iteration: int, epoch: int):
         pass
@@ -43,6 +58,8 @@ class TrainingListener:
 class ScoreIterationListener(TrainingListener):
     """Logs the loss every N iterations (ScoreIterationListener parity)."""
 
+    needs_per_iteration = False  # cadence-sampled score only
+
     def __init__(self, print_iterations: int = 10, out=None):
         self.print_iterations = max(1, print_iterations)
         self.out = out
@@ -61,6 +78,8 @@ class CollectScoresIterationListener(TrainingListener):
     """Collects (iteration, score) pairs (CollectScoresIterationListener
     parity)."""
 
+    needs_per_iteration = False  # cadence-sampled score only
+
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, frequency)
         self.scores: list[tuple[int, float]] = []
@@ -76,6 +95,8 @@ class PerformanceListener(TrainingListener):
     per-step FLOPs come from XLA's cost model on the compiled train step
     (SURVEY.md §5.1 — the reference has no MFU concept; the TPU framework
     reports it first-class), peak from the device kind."""
+
+    needs_per_iteration = True  # measures real wall-clock per step
 
     def __init__(self, frequency: int = 10, report_examples: bool = True,
                  flops_per_step: float | None = None):
@@ -133,6 +154,8 @@ class RecoveryEventListener(TrainingListener):
     listener-tier view of the resilience runtime's restarts, rollbacks
     and retries (ResilienceStats carries the counter view)."""
 
+    needs_per_iteration = False  # only observes recovery events
+
     def __init__(self, log: bool = True):
         self.log = log
         self.events: list = []
@@ -152,6 +175,11 @@ class RecoveryEventListener(TrainingListener):
 class ComposableIterationListener(TrainingListener):
     def __init__(self, *listeners):
         self.listeners = listeners
+
+    @property
+    def needs_per_iteration(self):
+        return any(getattr(l, "needs_per_iteration", True)
+                   for l in self.listeners)
 
     def iteration_done(self, net, iteration, epoch):
         for l in self.listeners:

@@ -16,6 +16,7 @@ the same component tier the reference's StatsUtils uses.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import asdict, dataclass
@@ -58,6 +59,9 @@ class TrainingStatsCollector:
         self.worker_id = worker_id
         self.events: List[EventStats] = []
         self._epoch = time.perf_counter()
+        # phases may now be timed from a background thread (the async
+        # checkpoint writer records checkpoint_barrier off the step path)
+        self._lock = threading.Lock()
 
     @contextmanager
     def time_phase(self, phase: str):
@@ -66,9 +70,10 @@ class TrainingStatsCollector:
             yield
         finally:
             t1 = time.perf_counter()
-            self.events.append(EventStats(
-                self.worker_id, phase, t0 - self._epoch,
-                (t1 - t0) * 1000.0))
+            ev = EventStats(self.worker_id, phase, t0 - self._epoch,
+                            (t1 - t0) * 1000.0)
+            with self._lock:
+                self.events.append(ev)
 
     # ------------------------------------------------------------ queries
     def phase_totals_ms(self) -> Dict[str, float]:

@@ -81,10 +81,18 @@ class ResilienceStats:
         self.rollbacks = 0
         self.preemptions = 0
         self.gc_removed = 0
+        self.nan_check_lag = 0
 
     def bump(self, counter: str, n: int = 1):
         with self._lock:
             setattr(self, counter, getattr(self, counter) + n)
+
+    def note_nan_check_lag(self, lag: int):
+        """Record how many steps behind the lazy NaN sentinel was when it
+        materialized a score (max over the run; 0 = checked at the step
+        boundary like the eager PR2 sentinel)."""
+        with self._lock:
+            self.nan_check_lag = max(self.nan_check_lag, int(lag))
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -95,6 +103,7 @@ class ResilienceStats:
                 "rollbacks_total": self.rollbacks,
                 "preemptions_total": self.preemptions,
                 "checkpoints_gc_total": self.gc_removed,
+                "nan_check_lag_max": self.nan_check_lag,
             }
 
 
@@ -122,9 +131,21 @@ class SupervisorConfig:
     #: multiply the learning rate by this after each NaN rollback
     nan_lr_backoff: float = 0.5
     max_nan_rollbacks: int = 3
-    #: check the loss for NaN/Inf every n steps (each check syncs the
-    #: device; 1 = catch poison before it can ever be checkpointed)
+    #: check the loss for NaN/Inf every n steps. Scores are kept as lazy
+    #: device arrays and only materialized (device sync) at the check
+    #: boundary, before every checkpoint snapshot (so poison is still
+    #: never checkpointed — the rollback window is unchanged), and at
+    #: exit; 1 = the eager per-step sentinel, larger values trade
+    #: detection lag (reported as ``nan_check_lag_max``) for a sync-free
+    #: step path. 0 disables the sentinel.
     nan_check_every: int = 1
+    #: hand the orbax write + meta/LATEST renames to a background writer
+    #: thread; the step path only pays a donation-safe device-side
+    #: snapshot. Barriers (join + error propagation) happen at the next
+    #: save, NaN rollback, preemption and exit, preserving the crash
+    #: contract: a crash during the background write still leaves the
+    #: previous valid checkpoint restorable.
+    async_checkpoints: bool = True
     handle_sigterm: bool = True
     #: injectable for tests (real runs sleep through backoff)
     sleep_fn: Callable[[float], None] = time.sleep
@@ -158,6 +179,11 @@ class TrainingSupervisor:
         self._preempt_requested = False
         self._last_good: Optional[str] = None
         self._lr_scale0 = getattr(net, "_lr_scale", 1.0)
+        #: async checkpoint writer state: at most ONE write in flight
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_pending: Optional[dict] = None
+        #: (step, lazy device score) pairs not yet NaN-checked
+        self._pending_scores: List[tuple] = []
         os.makedirs(config.checkpoint_dir, exist_ok=True)
 
     # --------------------------------------------------------------- events
@@ -178,10 +204,7 @@ class TrainingSupervisor:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.config.checkpoint_dir, f"step_{step}")
 
-    def _checkpoint(self, step: int, reason: str) -> str:
-        from deeplearning4j_tpu.utils.checkpoint import save_checkpoint
-        path = self._step_dir(step)
-        save_checkpoint(self.net, path, stats=self.stats_collector)
+    def _write_latest_pointer(self, path: str):
         # atomic latest-pointer: observers (and a quick resume fast path)
         # read one small file; the rename is the commit point, so the
         # pointer never names a half-written checkpoint
@@ -191,11 +214,76 @@ class TrainingSupervisor:
             f.write(os.path.basename(path))
         os.replace(tmp, os.path.join(self.config.checkpoint_dir,
                                      _LATEST_POINTER))
+
+    def _checkpoint(self, step: int, reason: str, wait: bool = False) -> str:
+        """Checkpoint the net's current state. With ``async_checkpoints``
+        the step path pays only a donation-safe device-side snapshot
+        (``snapshot_for_checkpoint``); the orbax write, meta.json rename
+        and LATEST pointer happen on a background writer thread. The
+        previous in-flight write is always drained first (one writer at a
+        time), and ``wait=True`` (preemption/final saves) drains this one
+        too. Writer errors — including injected crashes from the
+        faultinject seam, which fires inside the writer — surface at the
+        next drain point exactly as a synchronous save's would."""
+        from deeplearning4j_tpu.utils.checkpoint import (
+            save_checkpoint, snapshot_for_checkpoint)
+        cfg = self.config
+        self._drain_checkpoint()
+        path = self._step_dir(step)
+        if not cfg.async_checkpoints:
+            save_checkpoint(self.net, path, stats=self.stats_collector)
+            self._write_latest_pointer(path)
+            self._commit_checkpoint(step, reason, path)
+            return path
+        snap = snapshot_for_checkpoint(self.net)
+        pending = {"step": step, "reason": reason, "path": path,
+                   "error": None}
+
+        def write():
+            try:
+                save_checkpoint(snap, path, stats=self.stats_collector)
+                self._write_latest_pointer(path)
+            except BaseException as e:  # kept for the drain barrier
+                pending["error"] = e
+
+        t = threading.Thread(target=write, name="dl4j-ckpt-writer",
+                             daemon=True)
+        self._ckpt_pending = pending
+        self._ckpt_thread = t
+        t.start()
+        if wait:
+            self._drain_checkpoint()
+        return path
+
+    def _commit_checkpoint(self, step: int, reason: str, path: str):
+        """Post-write bookkeeping (main thread only): rollback target,
+        event/counter, retention GC."""
         self._last_good = path
         self._emit("checkpoint", step, f"{reason} -> {path}",
                    counter="checkpoints")
         self._gc(step)
-        return path
+
+    def _drain_checkpoint(self, raise_errors: bool = True):
+        """Barrier on the in-flight background write (no-op when idle).
+        On success the checkpoint becomes the rollback target; on failure
+        the stored exception (e.g. an InjectedCrash that fired between
+        the tree commit and the meta rename) is re-raised here — the
+        async analogue of a synchronous save crashing in place."""
+        t, pending = self._ckpt_thread, self._ckpt_pending
+        if t is None:
+            return
+        t.join()
+        self._ckpt_thread = None
+        self._ckpt_pending = None
+        err = pending["error"]
+        if err is not None:
+            if raise_errors:
+                raise err
+            logger.error("async checkpoint write for %s failed: %r",
+                         pending["path"], err)
+            return
+        self._commit_checkpoint(pending["step"], pending["reason"],
+                                pending["path"])
 
     def _gc(self, current_step: int):
         """Retention: keep the newest ``keep_checkpoints`` valid steps;
@@ -281,8 +369,27 @@ class TrainingSupervisor:
                 cfg.sleep_fn(delay)
                 delay = min(delay * cfg.backoff_factor, cfg.backoff_max_s)
 
+    def _flush_nan_checks(self):
+        """Materialize every pending lazy score (device sync happens HERE,
+        not on the step path) and return the first non-finite
+        ``(step, value)``, or None. Detection lag — how many steps ran
+        past a score before it was checked — is recorded in
+        ``ResilienceStats.nan_check_lag``."""
+        pending, self._pending_scores = self._pending_scores, []
+        bad = None
+        now = self.net.iteration
+        for step, score in pending:
+            self.stats.note_nan_check_lag(now - (step + 1))
+            if bad is None and not math.isfinite(float(score)):
+                bad = (step, float(score))
+        return bad
+
     def _rollback(self, step: int, score: float, rollbacks: int):
         cfg = self.config
+        # the poisoned trajectory's un-checked scores are moot after the
+        # restore, and the writer must be idle before _last_good is read
+        self._pending_scores.clear()
+        self._drain_checkpoint()
         if rollbacks > cfg.max_nan_rollbacks:
             raise TrainingDivergedError(
                 f"loss is non-finite ({score}) at step {step} even after "
@@ -339,32 +446,61 @@ class TrainingSupervisor:
 
             rollbacks = 0
             status = "completed"
-            while net.iteration < target_step:
+            while True:
                 if self._preempt_requested:
                     status = "preempted"
                     break
+                if net.iteration >= target_step:
+                    # tail flush: the last chunk of lazy scores may hold
+                    # poison — a rollback rewinds iteration and re-enters
+                    bad = self._flush_nan_checks()
+                    if bad is not None:
+                        rollbacks += 1
+                        self._rollback(bad[0], bad[1], rollbacks)
+                        continue
+                    break
                 step = net.iteration
                 score = self._attempt_step(batch_fn(step), step)
-                check = (cfg.nan_check_every > 0
-                         and net.iteration % cfg.nan_check_every == 0)
-                if check and not math.isfinite(float(score)):
-                    rollbacks += 1
-                    self._rollback(step, float(score), rollbacks)
-                    continue
-                if (net.iteration % cfg.checkpoint_every_steps == 0
-                        and net.iteration < target_step):
+                if cfg.nan_check_every > 0:
+                    self._pending_scores.append((step, score))
+                due_check = (cfg.nan_check_every > 0
+                             and net.iteration % cfg.nan_check_every == 0)
+                due_ckpt = (net.iteration % cfg.checkpoint_every_steps == 0
+                            and net.iteration < target_step)
+                if (due_check or due_ckpt) and self._pending_scores:
+                    # every score up to here is verified finite BEFORE a
+                    # snapshot is taken: poison is never checkpointed,
+                    # even with a lagging (nan_check_every > 1) sentinel
+                    bad = self._flush_nan_checks()
+                    if bad is not None:
+                        rollbacks += 1
+                        self._rollback(bad[0], bad[1], rollbacks)
+                        continue
+                if due_ckpt:
                     self._checkpoint(net.iteration, "periodic")
 
             if status == "preempted":
-                self._checkpoint(net.iteration, "preemption")
+                bad = self._flush_nan_checks()
+                if bad is not None:
+                    # never checkpoint poison, even on the way out
+                    rollbacks += 1
+                    self._rollback(bad[0], bad[1], rollbacks)
+                self._checkpoint(net.iteration, "preemption", wait=True)
                 self._emit("preempt", net.iteration,
                            f"clean exit at step {net.iteration} of "
                            f"{target_step}", counter="preemptions")
-            elif self._last_good != self._step_dir(net.iteration):
-                self._checkpoint(net.iteration, "final")
+            else:
+                self._drain_checkpoint()  # settle _last_good first
+                if self._last_good != self._step_dir(net.iteration):
+                    self._checkpoint(net.iteration, "final", wait=True)
         finally:
             if use_signal:
                 signal.signal(signal.SIGTERM, old_handler)
+            # exit barrier: when an exception is already propagating the
+            # writer's own error must not mask it — join + log only. On
+            # clean paths the writer was drained above (wait=True saves),
+            # so this is a no-op.
+            self._drain_checkpoint(raise_errors=False)
 
         return SupervisorResult(
             status=status, final_step=net.iteration,

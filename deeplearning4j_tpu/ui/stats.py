@@ -88,7 +88,14 @@ def _rss_mb() -> Optional[float]:
 
 class StatsListener(TrainingListener):
     """Collects a StatsReport every ``frequency`` iterations and routes it
-    to a StatsStorage (BaseStatsListener parity)."""
+    to a StatsStorage (BaseStatsListener parity).
+
+    ``net.score_value`` is only materialized (device sync) on the report
+    cadence — between reports only wall-clock timing is recorded, keeping
+    the lazy-score fit loop un-stalled."""
+
+    # real per-step wall-clock (iteration_ms) + pre-report param snapshots
+    needs_per_iteration = True
 
     def __init__(self, storage, frequency: int = 10, histograms: bool = True,
                  bins: int = 20, session_id: Optional[str] = None,

@@ -62,8 +62,52 @@ def _checkpointer():
 
 
 def _net_kind(net) -> str:
+    if isinstance(net, CheckpointSnapshot):
+        return net.kind
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     return "graph" if isinstance(net, ComputationGraph) else "multilayer"
+
+
+class CheckpointSnapshot:
+    """A frozen, donation-safe view of everything ``save_checkpoint``
+    reads from a net (params/state/opt_state trees + counters + config).
+
+    The fused train step donates the previous params/opt buffers to XLA,
+    so a background checkpoint writer cannot safely hold references to
+    the live ``net.params`` while the loop keeps stepping —
+    :func:`snapshot_for_checkpoint` takes ``jnp.copy`` of every leaf
+    (cheap asynchronous device-side copies) at submit time; the writer
+    then serializes the snapshot at its leisure."""
+
+    __slots__ = ("kind", "conf", "params", "state", "opt_state",
+                 "iteration", "epoch")
+
+    def __init__(self, kind, conf, params, state, opt_state, iteration,
+                 epoch):
+        self.kind = kind
+        self.conf = conf
+        self.params = params
+        self.state = state
+        self.opt_state = opt_state
+        self.iteration = iteration
+        self.epoch = epoch
+
+
+def snapshot_for_checkpoint(net) -> CheckpointSnapshot:
+    """Device-side copy of the net's checkpointable trees (see
+    :class:`CheckpointSnapshot`). ``save_checkpoint(snapshot, path)``
+    writes exactly what ``save_checkpoint(net, path)`` would have written
+    at this moment."""
+    import jax.numpy as jnp
+
+    def copy_tree(tree):
+        return jax.tree_util.tree_map(jnp.copy, tree)
+
+    return CheckpointSnapshot(
+        kind=_net_kind(net), conf=net.conf,
+        params=copy_tree(net.params), state=copy_tree(net.state or {}),
+        opt_state=copy_tree(net.opt_state),
+        iteration=int(net.iteration), epoch=int(net.epoch))
 
 
 def save_checkpoint(net, path: str, stats=None):

@@ -20,6 +20,13 @@ The net is dropout-free and seed-fixed, so the step sequence is
 deterministic given the step counter — which is exactly what the
 supervisor checkpoints and restores.
 
+This runs with ``async_checkpoints=True`` (the default): saves are
+snapshotted on the step path but written by a background thread, so an
+injected save-crash surfaces at the NEXT writer barrier (the following
+save / preemption / exit), a few steps past the doomed save. Resume and
+the final bit-identity verdict are unchanged — that deferral is exactly
+what ``tests/test_resilience.py`` pins.
+
 Run: ``python scripts/chaos_train.py`` (CPU is fine, ~20s). The slow
 pytest variant of this loop is
 ``tests/test_resilience.py::test_composite_chaos_run_slow``.

@@ -19,20 +19,35 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from bench import _peak_flops, calibrated_step_time
+from bench import _peak_flops, bench_host_loop, calibrated_step_time
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=["resnet50", "lenet", "char_rnn",
-                                       "mnist_mlp", "resnet18"])
+                                       "mnist_mlp", "resnet18", "host_loop"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--epochs", type=int, default=4,
+                    help="host_loop: timed fit epochs")
+    ap.add_argument("--n-batches", type=int, default=32,
+                    help="host_loop: minibatches per epoch")
     ap.add_argument("--f32", action="store_true")
     ap.add_argument("--breakdown", action="store_true")
     args = ap.parse_args()
+
+    if args.config == "host_loop":
+        # the fit-loop round: steps/sec through net.fit with the device
+        # step subtracted (bench.bench_host_loop) — probes the host
+        # dispatch path the async runtime pipelines, not the XLA step
+        batch = args.batch if args.batch != 256 else 1024
+        out = {"config": "host_loop"}
+        out.update(bench_host_loop(batch=batch, n_batches=args.n_batches,
+                                   epochs=args.epochs))
+        print(json.dumps(out))
+        return
 
     import jax
     import jax.numpy as jnp

@@ -365,3 +365,108 @@ def test_composite_chaos_run_slow(tmp_path):
     assert final is not None, "chaos run never completed"
     assert final.iteration == steps
     _assert_params_equal(ref, final)
+
+
+# ---------------------------------------------------------------------------
+# Async checkpointing: background writer, deferred crash barrier, lazy
+# NaN sentinel (nan_check_every > 1)
+# ---------------------------------------------------------------------------
+
+def test_async_checkpoint_crash_surfaces_at_barrier_and_resumes(tmp_path):
+    """With async_checkpoints (the default) an injected crash during the
+    background write surfaces at the NEXT drain barrier — training ran on
+    past the failed save — and the previous checkpoint stays restorable,
+    so a relaunch reaches bit-identical params."""
+    ds = _data()
+    ref = _reference(10, ds)
+    inj = FaultInjector().crash_during_save(2)  # 0=baseline, 1=step3, 2=step6
+    net = _mln()
+    with pytest.raises(InjectedCrash), inj.installed():
+        resilient_fit(net, ds, checkpoint_dir=str(tmp_path), epochs=10,
+                      checkpoint_every_steps=3, injector=inj)
+    # the error was held until the step-9 save drained the writer: steps
+    # 7..9 ran while the doomed write was in flight
+    assert net.iteration == 9
+    # crash footprint: step_6 is partial, step_3 is the newest valid
+    assert not is_valid_checkpoint(str(tmp_path / "step_6"))
+    assert find_latest_checkpoint(str(tmp_path)).endswith("step_3")
+    restored = restore_multi_layer_network(str(tmp_path / "step_3"))
+    _assert_params_equal(_reference(3, ds), restored)
+
+    relaunched = _mln()
+    res = resilient_fit(relaunched, ds, checkpoint_dir=str(tmp_path),
+                        epochs=10, checkpoint_every_steps=3)
+    assert res.status == "completed" and res.final_step == 10
+    assert res.resumed_from.endswith("step_3")
+    _assert_params_equal(ref, relaunched)
+
+
+def test_sync_checkpoint_mode_crashes_in_place(tmp_path):
+    """async_checkpoints=False restores the PR2 behavior: the save crash
+    propagates from the step that requested it."""
+    ds = _data()
+    inj = FaultInjector().crash_during_save(2)
+    net = _mln()
+    with pytest.raises(InjectedCrash), inj.installed():
+        resilient_fit(net, ds, checkpoint_dir=str(tmp_path), epochs=10,
+                      checkpoint_every_steps=3, injector=inj,
+                      async_checkpoints=False)
+    assert net.iteration == 6  # no run-ahead past the failed save
+
+
+def test_async_checkpoint_bit_identical_to_sync(tmp_path):
+    ds = _data()
+    a, b = _mln(), _mln()
+    resilient_fit(a, ds, checkpoint_dir=str(tmp_path / "sync"), epochs=8,
+                  checkpoint_every_steps=3, async_checkpoints=False)
+    resilient_fit(b, ds, checkpoint_dir=str(tmp_path / "async"), epochs=8,
+                  checkpoint_every_steps=3, async_checkpoints=True)
+    _assert_params_equal(a, b)
+    # both left the same final checkpoint on disk
+    for d in ("sync", "async"):
+        assert find_latest_checkpoint(str(tmp_path / d)).endswith("step_8")
+
+
+def test_lazy_nan_sentinel_detects_late_and_rolls_back_clean(tmp_path):
+    """nan_check_every=4: the poisoned step-5 score is only materialized
+    at the iteration-8 flush (detection lag), the flush runs BEFORE the
+    step-8 checkpoint so poison is never written, and rollback lands on
+    the pre-poison step-4 checkpoint."""
+    ds = _data()
+    inj = FaultInjector().poison_step(5)
+    net = _mln()
+    listener = RecoveryEventListener(log=False)
+    net.add_listener(listener)
+    res = resilient_fit(net, ds, checkpoint_dir=str(tmp_path), epochs=10,
+                        checkpoint_every_steps=4, injector=inj,
+                        nan_check_every=4, nan_lr_backoff=0.5)
+    assert res.status == "completed" and res.final_step == 10
+    assert res.stats["rollbacks_total"] == 1
+    # oldest score in a full window of 4 waits 3 steps for its check
+    assert res.stats["nan_check_lag_max"] == 3
+    assert net._lr_scale == pytest.approx(0.5)
+    rollback = [e for e in listener.events if e.kind == "rollback"][0]
+    assert "step 5" in rollback.detail and "step_4" in rollback.detail
+    # nothing on disk holds poison: the step-8 save was pre-empted by the
+    # flush, and the post-rollback rerun wrote clean state
+    for name in os.listdir(str(tmp_path)):
+        if not name.startswith("step_"):
+            continue
+        restored = restore_multi_layer_network(str(tmp_path / name))
+        for arr in _params(restored).values():
+            assert np.isfinite(arr).all(), f"poison saved in {name}"
+
+
+def test_lazy_sentinel_catches_poison_in_final_window(tmp_path):
+    """Poison in the tail chunk (after the last aligned flush) must still
+    be caught by the exit flush, not silently completed past."""
+    ds = _data()
+    inj = FaultInjector().poison_step(9)  # target 10, nan_check_every=4
+    net = _mln()
+    res = resilient_fit(net, ds, checkpoint_dir=str(tmp_path), epochs=10,
+                        checkpoint_every_steps=100, injector=inj,
+                        nan_check_every=4)
+    assert res.status == "completed" and res.final_step == 10
+    assert res.stats["rollbacks_total"] == 1
+    for arr in _params(net).values():
+        assert np.isfinite(arr).all()
