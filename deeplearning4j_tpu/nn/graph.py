@@ -13,6 +13,7 @@ accumulates output-layer scores the same way).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -20,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers import BaseLayerConfig
@@ -701,8 +704,9 @@ class ComputationGraph:
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = mds.num_examples
-        for l in self.listeners:
-            l.iteration_done(self, self.iteration, self.epoch)
+        with _get_tracer().span("score_sync"):
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration, self.epoch)
         return score
 
     def fit_batch(self, mds):
@@ -719,22 +723,30 @@ class ComputationGraph:
             self._train_step = self._build_train_step()
         else:
             self._resolve_remat()  # warn if DL4J_TPU_REMAT changed since
-        self._rng_key, rng = jax.random.split(self._rng_key)
-        inputs, fmasks = self._prepare_inputs(mds.features, mds.features_masks)
-        labels = [jnp.asarray(l) for l in mds.labels]
-        lmasks = [None if m is None else jnp.asarray(m)
-                  for m in mds.labels_masks]
-        if all(m is None for m in lmasks):
-            lmasks = None
-        it = jnp.asarray(self.iteration, jnp.int32)
-        self.params, self.state, self.opt_state, score = self._train_step(
-            self.params, self.state, self.opt_state, it, inputs, labels,
-            fmasks, lmasks, rng)
+        tracer = _get_tracer()
+        with tracer.span("host_dispatch"):
+            self._rng_key, rng = jax.random.split(self._rng_key)
+            inputs, fmasks = self._prepare_inputs(mds.features, mds.features_masks)
+            labels = [jnp.asarray(l) for l in mds.labels]
+            lmasks = [None if m is None else jnp.asarray(m)
+                      for m in mds.labels_masks]
+            if all(m is None for m in lmasks):
+                lmasks = None
+            it = jnp.asarray(self.iteration, jnp.int32)
+        with tracer.span("device_step"):
+            self.params, self.state, self.opt_state, score = self._train_step(
+                self.params, self.state, self.opt_state, it, inputs, labels,
+                fmasks, lmasks, rng)
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = mds.num_examples
-        for l in self.listeners:
-            l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners:
+            t0 = time.perf_counter()
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration, self.epoch)
+            t1 = time.perf_counter()
+            tracer.record("score_sync", t0, t1)
+            _obs_metrics.observe_dispatch_lag(t1 - t0)
         return score
 
     def fit(self, data, *, epochs: int = 1, async_prefetch: bool = True,
@@ -761,6 +773,8 @@ class ComputationGraph:
             AsyncDataSetIterator, DevicePrefetchIterator)
         chunk = self._resolve_multi_step(multi_step)
         device_prefetch = self._resolve_device_prefetch(device_prefetch)
+        _obs_metrics.install_runtime_metrics()
+        tracer = _get_tracer()
         for _ in range(epochs):
             source = data
             if async_prefetch and hasattr(data, "reset"):
@@ -768,11 +782,19 @@ class ComputationGraph:
             if device_prefetch:
                 source = DevicePrefetchIterator(
                     source, sharding=self._prefetch_sharding())
+            it0, t0 = self.iteration, time.perf_counter()
             if chunk > 1:
                 self._fit_epoch_chunked(source, chunk)
             else:
-                for d in source:
+                stream = iter(source)
+                while True:
+                    with tracer.span("data_wait"):
+                        d = next(stream, None)
+                    if d is None:
+                        break
                     self.fit_batch(d)
+            _obs_metrics.observe_step(self.iteration - it0,
+                                      time.perf_counter() - t0)
             if hasattr(data, "reset"):
                 data.reset()
             for l in self.listeners:
@@ -834,8 +856,14 @@ class ComputationGraph:
                     tuple(None if x is None else tuple(x.shape)
                           for x in m.labels_masks))
 
+        tracer = _get_tracer()
         buf, sig = [], None
-        for d in source:
+        stream = iter(source)
+        while True:
+            with tracer.span("data_wait"):
+                d = next(stream, None)
+            if d is None:
+                break
             m = self._coerce(d)
             s = signature(m)
             if buf and s != sig:
@@ -856,33 +884,37 @@ class ComputationGraph:
             self.fit_batch(batches[0])
             return
         from deeplearning4j_tpu.nn.multistep import get_multi_batch_step
-        jitted = get_multi_batch_step(self)
-        prepared = [self._prepare_inputs(m.features, m.features_masks)
-                    for m in batches]
-        inputs = {n: jnp.stack([p[0][n] for p in prepared])
-                  for n in prepared[0][0]}
-        fmasks = {n: jnp.stack([p[1][n] for p in prepared])
-                  for n in prepared[0][1]}
-        labels = [jnp.stack([jnp.asarray(m.labels[i]) for m in batches])
-                  for i in range(len(batches[0].labels))]
-        lmasks = [None if batches[0].labels_masks[i] is None else
-                  jnp.stack([jnp.asarray(m.labels_masks[i])
-                             for m in batches])
-                  for i in range(len(batches[0].labels_masks))]
-        if all(m is None for m in lmasks):
-            lmasks = None
-        it0 = jnp.asarray(self.iteration, jnp.int32)
-        steps = jnp.arange(len(batches), dtype=jnp.int32)
-        (self.params, self.state, self.opt_state, self._rng_key,
-         scores) = jitted(self.params, self.state, self.opt_state, it0,
-                          self._rng_key, steps,
-                          (inputs, labels, fmasks, lmasks))
+        tracer = _get_tracer()
+        with tracer.span("host_dispatch", steps=len(batches)):
+            jitted = get_multi_batch_step(self)
+            prepared = [self._prepare_inputs(m.features, m.features_masks)
+                        for m in batches]
+            inputs = {n: jnp.stack([p[0][n] for p in prepared])
+                      for n in prepared[0][0]}
+            fmasks = {n: jnp.stack([p[1][n] for p in prepared])
+                      for n in prepared[0][1]}
+            labels = [jnp.stack([jnp.asarray(m.labels[i]) for m in batches])
+                      for i in range(len(batches[0].labels))]
+            lmasks = [None if batches[0].labels_masks[i] is None else
+                      jnp.stack([jnp.asarray(m.labels_masks[i])
+                                 for m in batches])
+                      for i in range(len(batches[0].labels_masks))]
+            if all(m is None for m in lmasks):
+                lmasks = None
+            it0 = jnp.asarray(self.iteration, jnp.int32)
+            steps = jnp.arange(len(batches), dtype=jnp.int32)
+        with tracer.span("device_step", steps=len(batches)):
+            (self.params, self.state, self.opt_state, self._rng_key,
+             scores) = jitted(self.params, self.state, self.opt_state, it0,
+                              self._rng_key, steps,
+                              (inputs, labels, fmasks, lmasks))
         start = self.iteration
         self.iteration += len(batches)
         self.score_value = scores[-1]
         self.last_batch_examples = batches[-1].num_examples
-        self._replay_listeners(start, scores,
-                               [m.num_examples for m in batches])
+        with tracer.span("score_sync", steps=len(batches)):
+            self._replay_listeners(start, scores,
+                                   [m.num_examples for m in batches])
 
     def _replay_listeners(self, start: int, scores, examples):
         """Post-chunk iteration_done replay with per-iteration lazy score

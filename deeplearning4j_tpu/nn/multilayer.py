@@ -15,6 +15,7 @@ are pytrees keyed by layer name. Optional distribution: pass a
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
@@ -30,6 +31,8 @@ from deeplearning4j_tpu.datasets.iterator import (
     ListDataSetIterator,
 )
 from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf import layers as layer_confs
 from deeplearning4j_tpu.nn.conf.preprocessors import (
@@ -513,6 +516,8 @@ class MultiLayerNetwork:
                 self._tbptt_step = self._build_train_step()
             t_total = x.shape[1]
             score_sum, weight = 0.0, 0
+            _dev_span = _get_tracer().span("device_step", tbptt=True)
+            _dev_span.__enter__()
             for start in range(0, t_total, L):
                 sl = slice(start, min(start + L, t_total))
                 self._rng_key, rng = jax.random.split(self._rng_key)
@@ -529,6 +534,7 @@ class MultiLayerNetwork:
                 # pipeline once per chunk; consumers pull the final mean
                 score_sum = score_sum + chunk_score * w
                 weight += w
+            _dev_span.__exit__(None, None, None)
             self.state = self._strip_carries(self.state)
             score = score_sum / max(weight, 1)
         finally:
@@ -536,8 +542,9 @@ class MultiLayerNetwork:
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = ds.num_examples
-        for l in self.listeners:
-            l.iteration_done(self, self.iteration, self.epoch)
+        with _get_tracer().span("score_sync"):
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration, self.epoch)
         return score
 
     def fit_batch(self, ds: DataSet):
@@ -551,19 +558,27 @@ class MultiLayerNetwork:
             self._train_step = self._build_train_step()
         else:
             self._resolve_remat()  # warn if DL4J_TPU_REMAT changed since
-        self._rng_key, rng = jax.random.split(self._rng_key)
-        x = jnp.asarray(ds.features)
-        y = jnp.asarray(ds.labels)
-        fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-        it = jnp.asarray(self.iteration, jnp.int32)
-        self.params, self.state, self.opt_state, score = self._train_step(
-            self.params, self.state, self.opt_state, it, x, y, fmask, lmask, rng)
+        tracer = _get_tracer()
+        with tracer.span("host_dispatch"):
+            self._rng_key, rng = jax.random.split(self._rng_key)
+            x = jnp.asarray(ds.features)
+            y = jnp.asarray(ds.labels)
+            fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+            lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+            it = jnp.asarray(self.iteration, jnp.int32)
+        with tracer.span("device_step"):
+            self.params, self.state, self.opt_state, score = self._train_step(
+                self.params, self.state, self.opt_state, it, x, y, fmask, lmask, rng)
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = ds.num_examples
-        for l in self.listeners:
-            l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners:
+            t0 = time.perf_counter()
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration, self.epoch)
+            t1 = time.perf_counter()
+            tracer.record("score_sync", t0, t1)
+            _obs_metrics.observe_dispatch_lag(t1 - t0)
         return score
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
@@ -591,6 +606,8 @@ class MultiLayerNetwork:
             it = ArrayDataSetIterator(data, labels, batch_size=batch_size)
         chunk = self._resolve_multi_step(multi_step)
         device_prefetch = self._resolve_device_prefetch(device_prefetch)
+        _obs_metrics.install_runtime_metrics()
+        tracer = _get_tracer()
         for epoch in range(epochs):
             source = AsyncDataSetIterator(it) if async_prefetch else it
             if device_prefetch:
@@ -598,11 +615,19 @@ class MultiLayerNetwork:
                     source, sharding=self._prefetch_sharding())
             for l in self.listeners:
                 l.on_epoch_start(self)
+            it0, t0 = self.iteration, time.perf_counter()
             if chunk > 1:
                 self._fit_epoch_chunked(source, chunk)
             else:
-                for ds in source:
+                stream = iter(source)
+                while True:
+                    with tracer.span("data_wait"):
+                        ds = next(stream, None)
+                    if ds is None:
+                        break
                     self.fit_batch(ds)
+            _obs_metrics.observe_step(self.iteration - it0,
+                                      time.perf_counter() - t0)
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
@@ -659,8 +684,14 @@ class MultiLayerNetwork:
         ONE jitted scan over distinct batches (bit-identical to the
         per-batch loop, including the rng chain — see multistep.py)."""
         self._require_init()
+        tracer = _get_tracer()
         buf, sig = [], None
-        for ds in source:
+        stream = iter(source)
+        while True:
+            with tracer.span("data_wait"):
+                ds = next(stream, None)
+            if ds is None:
+                break
             s = (tuple(ds.features.shape), tuple(ds.labels.shape),
                  None if ds.features_mask is None
                  else tuple(ds.features_mask.shape),
@@ -684,24 +715,28 @@ class MultiLayerNetwork:
             self.fit_batch(batches[0])
             return
         from deeplearning4j_tpu.nn.multistep import get_multi_batch_step
-        jitted = get_multi_batch_step(self)
-        xs = jnp.stack([jnp.asarray(b.features) for b in batches])
-        ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
-        fmask = (None if batches[0].features_mask is None else
-                 jnp.stack([jnp.asarray(b.features_mask) for b in batches]))
-        lmask = (None if batches[0].labels_mask is None else
-                 jnp.stack([jnp.asarray(b.labels_mask) for b in batches]))
-        it0 = jnp.asarray(self.iteration, jnp.int32)
-        steps = jnp.arange(len(batches), dtype=jnp.int32)
-        (self.params, self.state, self.opt_state, self._rng_key,
-         scores) = jitted(self.params, self.state, self.opt_state, it0,
-                          self._rng_key, steps, (xs, ys, fmask, lmask))
+        tracer = _get_tracer()
+        with tracer.span("host_dispatch", steps=len(batches)):
+            jitted = get_multi_batch_step(self)
+            xs = jnp.stack([jnp.asarray(b.features) for b in batches])
+            ys = jnp.stack([jnp.asarray(b.labels) for b in batches])
+            fmask = (None if batches[0].features_mask is None else
+                     jnp.stack([jnp.asarray(b.features_mask) for b in batches]))
+            lmask = (None if batches[0].labels_mask is None else
+                     jnp.stack([jnp.asarray(b.labels_mask) for b in batches]))
+            it0 = jnp.asarray(self.iteration, jnp.int32)
+            steps = jnp.arange(len(batches), dtype=jnp.int32)
+        with tracer.span("device_step", steps=len(batches)):
+            (self.params, self.state, self.opt_state, self._rng_key,
+             scores) = jitted(self.params, self.state, self.opt_state, it0,
+                              self._rng_key, steps, (xs, ys, fmask, lmask))
         start = self.iteration
         self.iteration += len(batches)
         self.score_value = scores[-1]
         self.last_batch_examples = batches[-1].num_examples
-        self._replay_listeners(start, scores,
-                               [b.num_examples for b in batches])
+        with tracer.span("score_sync", steps=len(batches)):
+            self._replay_listeners(start, scores,
+                                   [b.num_examples for b in batches])
 
     def _replay_listeners(self, start: int, scores, examples):
         """Post-chunk iteration_done replay: every listener here declared

@@ -1,0 +1,25 @@
+"""Unified observability core: span tracing + cross-runtime metrics.
+
+``trace`` answers "where did step N spend its time" (bounded-ring span
+tracer, Chrome-trace/JSONL export, per-thread Perfetto lanes);
+``metrics`` is the single registry every runtime feeds (Prometheus text
+exposition + JSON snapshot). See OBSERVABILITY.md.
+"""
+
+from deeplearning4j_tpu.observability.trace import (  # noqa: F401
+    Span, Tracer, get_tracer, set_tracer, span, trace_span,
+    trace_timeline_component, export_trace_html, span_color,
+)
+from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
+    MetricFamily, MetricsRegistry, get_registry, set_registry,
+    install_runtime_metrics, observe_step, observe_dispatch_lag,
+    compile_stats,
+)
+
+__all__ = [
+    "Span", "Tracer", "get_tracer", "set_tracer", "span", "trace_span",
+    "trace_timeline_component", "export_trace_html", "span_color",
+    "MetricFamily", "MetricsRegistry", "get_registry", "set_registry",
+    "install_runtime_metrics", "observe_step", "observe_dispatch_lag",
+    "compile_stats",
+]

@@ -1,0 +1,530 @@
+"""Cross-runtime metrics registry with Prometheus text exposition.
+
+PRs 1-3 left four telemetry islands: ServingStats (JSON snapshot),
+ResilienceStats (counters), TrainingStatsCollector (phase events) and
+StatsListener (UI reports). This module is the single registry they all
+feed, rendered two ways: the existing JSON snapshots (unchanged, for
+back-compat) and Prometheus text exposition for scrapers.
+
+Two kinds of participants:
+
+- **Direct instruments** — ``registry.counter(...)``/``gauge``/
+  ``histogram`` families with ``.labels(...)`` children, owned by the
+  registry. Used for the runtime metrics that exist nowhere else
+  (XLA compile count/seconds, device memory, steps/sec, dispatch lag).
+- **Collectors** — callables registered with ``register_collector``
+  that return metric families at render time. ServingStats and
+  ResilienceStats keep their own lock-guarded counters (their JSON
+  snapshots and tests stay untouched) and attach a collector view, so
+  there is one source of truth and zero double bookkeeping.
+
+Naming follows Prometheus conventions: ``dl4j_`` prefix, ``_total``
+suffix on counters, base units (seconds, bytes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
+    "Sample", "get_registry", "set_registry",
+    "install_runtime_metrics", "observe_step", "observe_dispatch_lag",
+    "wants_prometheus", "PROMETHEUS_CONTENT_TYPE",
+]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def wants_prometheus(accept: str, query: str = "") -> bool:
+    """/metrics content negotiation: Prometheus text when the client
+    asks for it (scrapers send ``Accept: text/plain`` or an openmetrics
+    type, or ``?format=prometheus`` forces it); JSON otherwise — the
+    pre-existing payload stays the default for ``Accept: */*``."""
+    if "format=prometheus" in (query or ""):
+        return True
+    a = (accept or "").lower()
+    return "text/plain" in a or "openmetrics" in a
+
+_VALID_KINDS = ("counter", "gauge", "histogram")
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, float("inf"))
+
+
+def _escape_label_value(v: str) -> str:
+    # Exposition-format escaping: backslash, double-quote, newline.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v != v:  # NaN
+        return "NaN"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+class Sample(Tuple):
+    """(suffix, labels, value) — suffix is appended to the family name
+    ("" for the plain sample, "_bucket"/"_sum"/"_count" for histograms)."""
+
+    def __new__(cls, suffix: str, labels: Dict[str, str], value: float):
+        return super().__new__(cls, (suffix, labels, value))
+
+    @property
+    def suffix(self):
+        return self[0]
+
+    @property
+    def labels(self):
+        return self[1]
+
+    @property
+    def value(self):
+        return self[2]
+
+
+class MetricFamily:
+    """One named metric + HELP/TYPE + its samples. Collectors return
+    lists of these; direct instruments render themselves into these."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 samples: Optional[List[Sample]] = None):
+        if kind not in _VALID_KINDS:
+            raise ValueError(f"metric kind must be one of {_VALID_KINDS}")
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.samples: List[Sample] = samples if samples is not None else []
+
+    def add(self, value: float, labels: Optional[Dict[str, str]] = None,
+            suffix: str = ""):
+        self.samples.append(Sample(suffix, labels or {}, value))
+        return self
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for s in self.samples:
+            if s.labels:
+                inner = ",".join(
+                    f'{k}="{_escape_label_value(v)}"'
+                    for k, v in sorted(s.labels.items()))
+                lines.append(
+                    f"{self.name}{s.suffix}{{{inner}}} {_fmt_value(s.value)}")
+            else:
+                lines.append(f"{self.name}{s.suffix} {_fmt_value(s.value)}")
+        return "\n".join(lines)
+
+    def to_json(self):
+        if len(self.samples) == 1 and not self.samples[0].labels \
+                and not self.samples[0].suffix:
+            return self.samples[0].value
+        return [{"labels": s.labels, "value": s.value,
+                 **({"suffix": s.suffix} if s.suffix else {})}
+                for s in self.samples]
+
+
+class _Child:
+    """One labeled child of a family; value updates are lock-guarded by
+    the owning registry's lock (coarse, but these are cold-ish paths —
+    the span tracer owns the per-step hot path)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._value = 0.0
+
+
+class _CounterChild(_Child):
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _GaugeChild(_Child):
+    __slots__ = ("_fn",)
+
+    def __init__(self, lock):
+        super().__init__(lock)
+        self._fn = None
+
+    def set(self, value: float):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    def set_function(self, fn: Callable[[], float]):
+        """Lazily evaluated at render time (queue depths, clock-derived
+        rates)."""
+        self._fn = fn
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return float("nan")
+        return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, lock, buckets):
+        self._lock = lock
+        self._buckets = buckets
+        self._counts = [0] * len(buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            # per-bucket counts; collect() accumulates into the
+            # cumulative le-series the exposition format wants
+            for i, b in enumerate(self._buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    break
+
+
+class _Family:
+    def __init__(self, registry, name, kind, help, labelnames, buckets=None):
+        self._registry = registry
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = buckets
+        self._children: Dict[Tuple[str, ...], object] = {}
+        # Label-less families get one implicit child so counter.inc()
+        # works without .labels().
+        if not self.labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        lock = self._registry._lock
+        if self.kind == "counter":
+            return _CounterChild(lock)
+        if self.kind == "gauge":
+            return _GaugeChild(lock)
+        return _HistogramChild(lock, self.buckets)
+
+    def labels(self, **kv):
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(kv)}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._registry._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+        return child
+
+    # label-less convenience passthroughs
+    def inc(self, amount: float = 1.0):
+        self._children[()].inc(amount)
+
+    def set(self, value: float):
+        self._children[()].set(value)
+
+    def set_function(self, fn):
+        self._children[()].set_function(fn)
+
+    def observe(self, value: float):
+        self._children[()].observe(value)
+
+    @property
+    def value(self):
+        return self._children[()].value
+
+    def collect(self) -> MetricFamily:
+        fam = MetricFamily(self.name, self.kind, self.help)
+        with self._registry._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            labels = dict(zip(self.labelnames, key))
+            if self.kind == "histogram":
+                cumulative = 0
+                for b, c in zip(child._buckets, child._counts):
+                    cumulative += c
+                    fam.add(cumulative,
+                            {**labels, "le": _fmt_value(b)}, "_bucket")
+                fam.add(child._sum, labels, "_sum")
+                fam.add(child._count, labels, "_count")
+            else:
+                fam.add(child.value, labels)
+        return fam
+
+
+# Public aliases so isinstance/typing reads naturally downstream.
+Counter = Gauge = Histogram = _Family
+
+
+class MetricsRegistry:
+    """The central registry: direct instrument families + render-time
+    collectors, rendered as Prometheus text or a JSON snapshot."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[[], Sequence[MetricFamily]]] = []
+
+    # ----------------------------------------------------------- instruments
+    def _family(self, name, kind, help, labelnames, buckets=None):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.labelnames}")
+                return fam
+            fam = _Family(self, name, kind, help, labelnames, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> _Family:
+        return self._family(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> _Family:
+        return self._family(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
+        buckets = tuple(buckets)
+        if not buckets or buckets[-1] != float("inf"):
+            buckets = buckets + (float("inf"),)
+        return self._family(name, "histogram", help, labelnames, buckets)
+
+    # ------------------------------------------------------------ collectors
+    def register_collector(self, fn: Callable[[], Sequence[MetricFamily]]):
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+        return fn
+
+    def unregister_collector(self, fn):
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    # -------------------------------------------------------------- renderers
+    def collect(self) -> List[MetricFamily]:
+        with self._lock:
+            families = list(self._families.values())
+            collectors = list(self._collectors)
+        out = [f.collect() for f in families]
+        for fn in collectors:
+            try:
+                out.extend(fn())
+            except Exception:
+                # A broken collector must not take down the scrape
+                # endpoint; its series simply go missing.
+                continue
+        return out
+
+    def render_prometheus(self) -> str:
+        return "\n".join(f.render() for f in self.collect()) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON view: {name: value | [{labels, value}...]}."""
+        return {f.name: f.to_json() for f in self.collect()}
+
+
+# --------------------------------------------------------------------------
+# process-global registry
+# --------------------------------------------------------------------------
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _GLOBAL
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (tests). Returns the previous
+    one. Runtime metrics (compile/memory/steps) re-install themselves
+    into the new registry on next touch."""
+    global _GLOBAL, _RUNTIME_INSTALLED_ON
+    prev, _GLOBAL = _GLOBAL, registry
+    with _runtime_lock:
+        _RUNTIME_INSTALLED_ON = None
+    return prev
+
+
+# --------------------------------------------------------------------------
+# runtime metrics: XLA compile events, device memory, async-loop rates
+# --------------------------------------------------------------------------
+#
+# Compile accounting rides jax.monitoring's event-duration stream:
+# every backend compile fires '/jax/core/compile/backend_compile_duration'
+# (a user-visible jit may fire several — internal jits count too, which
+# is exactly what a "are we recompiling?" alarm wants). The listener is
+# registered once per process; jax.monitoring has no unregister API.
+
+_runtime_lock = threading.Lock()
+_COMPILE = {"count": 0, "seconds": 0.0}
+_COMPILE_LISTENER_ON = False
+_RUNTIME_INSTALLED_ON: Optional[MetricsRegistry] = None
+_STEPS = {"count": 0.0, "per_sec": 0.0, "dispatch_lag_s": 0.0}
+
+
+def _on_jax_event_duration(event: str, duration: float, **kw):
+    if event.endswith("backend_compile_duration"):
+        with _runtime_lock:
+            _COMPILE["count"] += 1
+            _COMPILE["seconds"] += duration
+
+
+def _ensure_compile_listener():
+    global _COMPILE_LISTENER_ON
+    if _COMPILE_LISTENER_ON:
+        return
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(
+            _on_jax_event_duration)
+        _COMPILE_LISTENER_ON = True
+    except Exception:
+        pass
+
+
+def _runtime_collector() -> List[MetricFamily]:
+    with _runtime_lock:
+        compile_count = _COMPILE["count"]
+        compile_secs = _COMPILE["seconds"]
+        steps = dict(_STEPS)
+    fams = [
+        MetricFamily("dl4j_xla_compile_total", "counter",
+                     "XLA backend compiles observed via jax.monitoring"
+                     ).add(compile_count),
+        MetricFamily("dl4j_xla_compile_seconds_total", "counter",
+                     "Cumulative XLA backend compile wall-clock seconds"
+                     ).add(compile_secs),
+        MetricFamily("dl4j_fit_steps_total", "counter",
+                     "Training steps dispatched by the fit loop"
+                     ).add(steps["count"]),
+        MetricFamily("dl4j_fit_steps_per_second", "gauge",
+                     "Recent fit-loop dispatch rate (steps/sec)"
+                     ).add(steps["per_sec"]),
+        MetricFamily("dl4j_fit_dispatch_lag_seconds", "gauge",
+                     "Last observed host->device dispatch lag (time the "
+                     "host waited on device results at a sync point)"
+                     ).add(steps["dispatch_lag_s"]),
+    ]
+    mem = MetricFamily(
+        "dl4j_device_memory_bytes", "gauge",
+        "Per-device memory from jax.local_devices()[i].memory_stats(); "
+        "backends that do not report (e.g. CPU) fall back to one "
+        "process-wide kind=\"host_rss_bytes\" sample")
+    reported = False
+    try:
+        import jax
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            dev = f"{d.platform}:{d.id}"
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit", "bytes_reserved"):
+                if key in stats:
+                    mem.add(stats[key], {"device": dev, "kind": key})
+                    reported = True
+    except Exception:
+        pass
+    if not reported:
+        rss = _host_rss_bytes()
+        if rss is not None:
+            mem.add(rss, {"device": "process", "kind": "host_rss_bytes"})
+    if mem.samples:
+        fams.append(mem)
+    return fams
+
+
+def _host_rss_bytes() -> Optional[float]:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except OSError:
+        return None
+    return None
+
+
+def install_runtime_metrics(
+        registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Idempotently attach the runtime collector (compile count/seconds,
+    device memory, steps/sec, dispatch lag) + the jax.monitoring compile
+    listener to *registry* (default: the global one). Called by the fit
+    loops and both servers, so any surfaced registry carries these."""
+    global _RUNTIME_INSTALLED_ON
+    reg = registry or get_registry()
+    _ensure_compile_listener()
+    with _runtime_lock:
+        if _RUNTIME_INSTALLED_ON is reg:
+            return reg
+        _RUNTIME_INSTALLED_ON = reg
+    reg.register_collector(_runtime_collector)
+    return reg
+
+
+def observe_step(n: int = 1, wall_s: Optional[float] = None):
+    """Fit loops report dispatched steps; steps/sec derives from the
+    wall-clock the caller measured for those n steps."""
+    with _runtime_lock:
+        _STEPS["count"] += n
+        if wall_s and wall_s > 0:
+            _STEPS["per_sec"] = n / wall_s
+
+
+def observe_dispatch_lag(seconds: float):
+    """Record the latest host->device sync wait (e.g. a score_sync)."""
+    with _runtime_lock:
+        _STEPS["dispatch_lag_s"] = float(seconds)
+
+
+def compile_stats() -> dict:
+    with _runtime_lock:
+        return dict(_COMPILE)
+
+
+def _monotonic() -> float:
+    return time.perf_counter()

@@ -219,6 +219,15 @@ class ProfilerListener(TrainingListener):
         # start_trace in this process
         atexit.register(self.close)
 
+    def _warn_once(self, what: str, exc: Exception):
+        if not getattr(self, "_warned", False):
+            self._warned = True
+            import logging
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "ProfilerListener: %s failed (%s: %s) — profiling "
+                "disabled for this window, training continues",
+                what, type(exc).__name__, exc)
+
     def _stop(self, net):
         import jax
         # sync so the trace includes the in-flight device work
@@ -227,7 +236,13 @@ class ProfilerListener(TrainingListener):
                 float(net.score_value)
             except Exception:
                 pass
-        jax.profiler.stop_trace()
+        # idempotent: a second listener instance (or anything else) may
+        # already have stopped the process-wide trace — stop_trace then
+        # raises, which must not abort training or leave _active stuck
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self._warn_once("stop_trace", e)
         self._active = False
         self.captured = True
 
@@ -240,7 +255,15 @@ class ProfilerListener(TrainingListener):
         import jax
         if (not self.captured and not self._active
                 and iteration >= self.start_iteration):
-            jax.profiler.start_trace(self.log_dir)
+            # idempotent: the process-wide trace may already be running
+            # (a re-attached listener, or an outer profiling harness) —
+            # start_trace raises; warn once, mark captured, keep training
+            try:
+                jax.profiler.start_trace(self.log_dir)
+            except Exception as e:
+                self._warn_once("start_trace", e)
+                self.captured = True
+                return
             self._active = True
             self._stop_at = iteration + self.num_iterations
             return

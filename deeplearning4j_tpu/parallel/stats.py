@@ -74,12 +74,25 @@ class TrainingStatsCollector:
                             (t1 - t0) * 1000.0)
             with self._lock:
                 self.events.append(ev)
+            # phases land in the unified span timeline too, so a Spark-
+            # tier `average` shows up against fit-loop/checkpoint spans
+            from deeplearning4j_tpu.observability.trace import get_tracer
+            get_tracer().record(phase, t0, t1,
+                                {"worker": self.worker_id})
+
+    def _snapshot(self) -> List[EventStats]:
+        """Copy under the lock: the async checkpoint writer may still be
+        appending while a reader iterates (seen as a mid-iteration
+        ``RuntimeError: list changed size`` / missing-tail race before
+        this existed). ALL readers go through here."""
+        with self._lock:
+            return list(self.events)
 
     # ------------------------------------------------------------ queries
     def phase_totals_ms(self) -> Dict[str, float]:
         """Total wall-clock per phase (the getSummaryStats table)."""
         out: Dict[str, float] = {}
-        for e in self.events:
+        for e in self._snapshot():
             out[e.phase] = out.get(e.phase, 0.0) + e.duration_ms
         return out
 
@@ -92,7 +105,7 @@ class TrainingStatsCollector:
         import numpy as np
         from jax.experimental import multihost_utils
 
-        payload = json.dumps([e.to_dict() for e in self.events])
+        payload = json.dumps([e.to_dict() for e in self._snapshot()])
         buf = np.frombuffer(payload.encode(), dtype=np.uint8)
         # ragged gather: pad to the global max length
         n = np.asarray(len(buf))
@@ -112,7 +125,7 @@ class TrainingStatsCollector:
         """Publish this worker's events through a StatsStorage/router
         (``put_static_info`` — the dashboard's /api/phases reads it)."""
         storage.put_static_info(session_id, self.worker_id, {
-            "phase_stats": [e.to_dict() for e in self.events]})
+            "phase_stats": [e.to_dict() for e in self._snapshot()]})
 
 
 def timeline_component(events: Sequence[EventStats],

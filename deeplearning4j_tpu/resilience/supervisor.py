@@ -45,6 +45,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 _LATEST_POINTER = "LATEST"
@@ -105,6 +108,50 @@ class ResilienceStats:
                 "checkpoints_gc_total": self.gc_removed,
                 "nan_check_lag_max": self.nan_check_lag,
             }
+
+    # ------------------------------------------- unified-registry bridge
+    # Mirrors ServingStats.attach_to_registry: the counters stay the
+    # source of truth, the registry renders them at scrape time.
+
+    _HELP = {
+        "resumes_total": "Runs resumed from a checkpoint",
+        "checkpoints_total": "Checkpoints committed",
+        "retries_total": "Transient step failures retried",
+        "rollbacks_total": "NaN/Inf rollbacks to the last good checkpoint",
+        "preemptions_total": "Clean preemption exits",
+        "checkpoints_gc_total": "Old/partial checkpoints removed by GC",
+        "nan_check_lag_max": "Max steps the lazy NaN sentinel lagged",
+    }
+
+    def metric_families(self, labels=None):
+        from deeplearning4j_tpu.observability.metrics import MetricFamily
+
+        L = dict(labels or {})
+        out = []
+        for key, value in self.snapshot().items():
+            kind = "gauge" if key == "nan_check_lag_max" else "counter"
+            out.append(MetricFamily(f"dl4j_resilience_{key}", kind,
+                                    self._HELP[key]).add(value, L))
+        return out
+
+    def attach_to_registry(self, registry=None, *, labels=None):
+        from deeplearning4j_tpu.observability.metrics import get_registry
+
+        self.detach_from_registry()
+        reg = registry if registry is not None else get_registry()
+
+        def _collect():
+            return self.metric_families(labels)
+
+        reg.register_collector(_collect)
+        self._registry, self._collector = reg, _collect
+        return reg
+
+    def detach_from_registry(self):
+        reg = getattr(self, "_registry", None)
+        if reg is not None:
+            reg.unregister_collector(self._collector)
+            self._registry = self._collector = None
 
 
 def _default_retry_on():
@@ -228,21 +275,28 @@ class TrainingSupervisor:
         from deeplearning4j_tpu.utils.checkpoint import (
             save_checkpoint, snapshot_for_checkpoint)
         cfg = self.config
+        tracer = _get_tracer()
         self._drain_checkpoint()
         path = self._step_dir(step)
         if not cfg.async_checkpoints:
-            save_checkpoint(self.net, path, stats=self.stats_collector)
-            self._write_latest_pointer(path)
+            with tracer.span("checkpoint_write", step=step, reason=reason):
+                save_checkpoint(self.net, path, stats=self.stats_collector)
+                self._write_latest_pointer(path)
             self._commit_checkpoint(step, reason, path)
             return path
-        snap = snapshot_for_checkpoint(self.net)
+        with tracer.span("checkpoint_snapshot", step=step):
+            snap = snapshot_for_checkpoint(self.net)
         pending = {"step": step, "reason": reason, "path": path,
                    "error": None}
 
         def write():
+            # runs on dl4j-ckpt-writer: the span lands in that thread's
+            # trace lane, overlapping the main loop's device_step spans
             try:
-                save_checkpoint(snap, path, stats=self.stats_collector)
-                self._write_latest_pointer(path)
+                with tracer.span("checkpoint_write", step=step,
+                                 reason=reason):
+                    save_checkpoint(snap, path, stats=self.stats_collector)
+                    self._write_latest_pointer(path)
             except BaseException as e:  # kept for the drain barrier
                 pending["error"] = e
 
@@ -272,7 +326,8 @@ class TrainingSupervisor:
         t, pending = self._ckpt_thread, self._ckpt_pending
         if t is None:
             return
-        t.join()
+        with _get_tracer().span("checkpoint_barrier"):
+            t.join()
         self._ckpt_thread = None
         self._ckpt_pending = None
         err = pending["error"]
@@ -401,7 +456,8 @@ class TrainingSupervisor:
                 f"loss is non-finite ({score}) at step {step} and no good "
                 "checkpoint exists to roll back to")
         new_scale = getattr(self.net, "_lr_scale", 1.0) * cfg.nan_lr_backoff
-        self._load_into(self._last_good)
+        with _get_tracer().span("rollback", step=step):
+            self._load_into(self._last_good)
         if hasattr(self.net, "set_lr_scale"):
             self.net.set_lr_scale(new_scale)
         self._emit("rollback", self.net.iteration,
@@ -422,10 +478,19 @@ class TrainingSupervisor:
         net = self.net
         resumed_from = None
 
+        _obs_metrics.install_runtime_metrics()
+        # attach (and stay attached after run(): a post-run scrape still
+        # reports this job's recovery counters alongside serving/compile
+        # series from the same process)
+        self.stats.attach_to_registry(
+            labels={"job": os.path.basename(
+                os.path.normpath(cfg.checkpoint_dir))})
+
         if cfg.resume:
             latest = find_latest_checkpoint(cfg.checkpoint_dir)
             if latest is not None:
-                self._load_into(latest)
+                with _get_tracer().span("restore"):
+                    self._load_into(latest)
                 self._emit("resume", net.iteration, f"restored {latest}",
                            counter="resumes")
                 resumed_from = latest

@@ -40,6 +40,8 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
+
 
 class QueueFullError(RuntimeError):
     """Admission control: the pending-ticket queue is at ``max_queue``."""
@@ -68,13 +70,14 @@ def next_bucket(n: int, max_batch: int, min_batch: int = 1) -> int:
 
 
 class _Ticket:
-    __slots__ = ("feats", "rows", "key", "future")
+    __slots__ = ("feats", "rows", "key", "future", "t_submit")
 
     def __init__(self, feats, rows, key):
         self.feats = feats
         self.rows = rows
         self.key = key
         self.future = Future()
+        self.t_submit = time.perf_counter()
 
 
 class MicroBatcher:
@@ -226,6 +229,11 @@ class MicroBatcher:
                     if not self._pending:
                         return  # stopping and fully drained
                     batch, rows = self._gather_locked()
+                # one queue_wait span per device forward, timed from the
+                # oldest ticket's submit (the worst wait in the batch)
+                _get_tracer().record("queue_wait", batch[0].t_submit,
+                                     time.perf_counter(),
+                                     {"tickets": len(batch)})
                 self._execute(batch, rows)
                 batch = None
         except BaseException as e:  # noqa: BLE001 — device thread death
@@ -251,16 +259,19 @@ class MicroBatcher:
 
     def _execute(self, batch, rows):
         n_inputs = len(batch[0].feats)
+        tracer = _get_tracer()
         try:
-            feats = [np.concatenate([t.feats[i] for t in batch])
-                     if len(batch) > 1 else batch[0].feats[i]
-                     for i in range(n_inputs)]
-            bucket = next_bucket(rows, self.max_batch, self.min_batch)
-            if bucket != rows:
-                feats = [np.pad(f, [(0, bucket - rows)] + [(0, 0)]
-                                * (f.ndim - 1)) for f in feats]
-            self.shapes_seen.add(bucket)
-            out = self._forward(feats)
+            with tracer.span("batch_assembly", tickets=len(batch)):
+                feats = [np.concatenate([t.feats[i] for t in batch])
+                         if len(batch) > 1 else batch[0].feats[i]
+                         for i in range(n_inputs)]
+                bucket = next_bucket(rows, self.max_batch, self.min_batch)
+                if bucket != rows:
+                    feats = [np.pad(f, [(0, bucket - rows)] + [(0, 0)]
+                                    * (f.ndim - 1)) for f in feats]
+                self.shapes_seen.add(bucket)
+            with tracer.span("device_compute", bucket=bucket, rows=rows):
+                out = self._forward(feats)
         except Exception as e:
             for t in batch:
                 if self.stats is not None:

@@ -113,3 +113,86 @@ class ServingStats:
                 "shapes_seen": sorted(int(s) for s in shapes_seen),
             }
         return out
+
+    # ------------------------------------------- unified-registry bridge
+    # The lock-guarded counters above stay the single source of truth
+    # (snapshot() and its tests are untouched); the registry sees them
+    # through a render-time collector, so Prometheus scrapes and the
+    # JSON endpoint can never disagree.
+
+    def metric_families(self, shapes_seen=(), labels=None):
+        from deeplearning4j_tpu.observability.metrics import MetricFamily
+
+        snap = self.snapshot(shapes_seen)
+        L = dict(labels or {})
+        fams = []
+
+        def fam(name, kind, help, value, extra=None):
+            fams.append(MetricFamily(name, kind, help)
+                        .add(value, {**L, **(extra or {})}))
+
+        fam("dl4j_serving_requests_total", "counter",
+            "Accepted /predict requests", snap["requests_total"])
+        fam("dl4j_serving_rows_total", "counter",
+            "Real (unpadded) rows served", snap["rows_total"])
+        fam("dl4j_serving_batches_total", "counter",
+            "Device forwards executed", snap["batches_total"])
+        fam("dl4j_serving_rejected_total", "counter",
+            "503 admission rejections", snap["rejected_total"])
+        fam("dl4j_serving_errors_total", "counter",
+            "Request failures", snap["errors_total"])
+        fam("dl4j_serving_timeouts_total", "counter",
+            "504 per-request deadline expiries", snap["timeouts_total"])
+        fam("dl4j_serving_queue_depth", "gauge",
+            "Tickets pending in the micro-batch queue",
+            snap["queue_depth"])
+        lat = MetricFamily(
+            "dl4j_serving_latency_ms", "gauge",
+            "Recent-window request latency percentiles (ms)")
+        for q, v in snap["latency_ms"].items():
+            if v is not None:
+                lat.add(v, {**L, "quantile": q})
+        if lat.samples:
+            fams.append(lat)
+        hist = MetricFamily(
+            "dl4j_serving_batch_executions_total", "counter",
+            "Device forwards by executed bucket size")
+        for bucket, count in snap["batch_size_hist"].items():
+            hist.add(count, {**L, "bucket": bucket})
+        if hist.samples:
+            fams.append(hist)
+        if snap["coalesce_rows_per_batch"] is not None:
+            fam("dl4j_serving_coalesce_rows_per_batch", "gauge",
+                "Mean real rows per device forward (cross-request "
+                "coalescing signal)", snap["coalesce_rows_per_batch"])
+            fam("dl4j_serving_coalesce_requests_per_batch", "gauge",
+                "Mean tickets per device forward",
+                snap["coalesce_requests_per_batch"])
+        fam("dl4j_serving_compiled_buckets", "gauge",
+            "Distinct padded bucket shapes executed (XLA compile-cache "
+            "footprint of the bucket ladder)", snap["compile_count"])
+        return fams
+
+    def attach_to_registry(self, registry=None, *, labels=None,
+                           shapes_fn=None):
+        """Register a collector view of these stats on *registry*
+        (default: the process-global one). ``shapes_fn`` supplies the
+        server's live ``shapes_seen`` set at render time."""
+        from deeplearning4j_tpu.observability.metrics import get_registry
+
+        self.detach_from_registry()
+        reg = registry if registry is not None else get_registry()
+
+        def _collect():
+            shapes = shapes_fn() if shapes_fn is not None else ()
+            return self.metric_families(shapes, labels)
+
+        reg.register_collector(_collect)
+        self._registry, self._collector = reg, _collect
+        return reg
+
+    def detach_from_registry(self):
+        reg = getattr(self, "_registry", None)
+        if reg is not None:
+            reg.unregister_collector(self._collector)
+            self._registry = self._collector = None

@@ -28,7 +28,9 @@ Endpoints:
 - ``POST /predict``  {"features": [[...]]} or {"inputs": [[[...]], ...]}
   -> {"predictions": ...}
 - ``GET /healthz``   liveness + model summary sizes
-- ``GET /metrics``   ServingStats snapshot (JSON)
+- ``GET /metrics``   ServingStats snapshot (JSON); with
+  ``Accept: text/plain`` (or ``?format=prometheus``) the unified
+  registry in Prometheus text exposition instead
 """
 
 from __future__ import annotations
@@ -40,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError,
                                                 next_bucket)
@@ -227,6 +230,15 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, text, code=200,
+                      content_type=_obs_metrics.PROMETHEUS_CONTENT_TYPE):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802
                 if self.path.startswith("/healthz"):
                     if not server._batcher.healthy:
@@ -241,7 +253,15 @@ class ModelServer:
                                 "params": int(server.net.num_params()),
                                 "graph": server._is_graph})
                 elif self.path.startswith("/metrics"):
-                    self._json(server.stats.snapshot(server.shapes_seen))
+                    if _obs_metrics.wants_prometheus(
+                            self.headers.get("Accept", ""), self.path):
+                        # the full unified registry (serving + resilience
+                        # + compile + device-memory series), not just the
+                        # serving slice — one scrape sees the process
+                        self._text(_obs_metrics.get_registry()
+                                   .render_prometheus())
+                    else:
+                        self._json(server.stats.snapshot(server.shapes_seen))
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -277,6 +297,10 @@ class ModelServer:
 
         self._httpd = _ServingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        _obs_metrics.install_runtime_metrics()
+        self.stats.attach_to_registry(
+            labels={"server": f"{self.host}:{self.port}"},
+            shapes_fn=lambda: self.shapes_seen)
         import threading
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -299,6 +323,7 @@ class ModelServer:
             self._httpd.server_close()
             self._httpd = None
         self._batcher.stop()
+        self.stats.detach_from_registry()
 
 
 def serve(net, host: str = "127.0.0.1", port: int = 9500,

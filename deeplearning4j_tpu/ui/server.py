@@ -11,6 +11,10 @@ backed by any attached ``BaseStatsStorage``:
 - ``GET /api/sessions``                        session/worker inventory
 - ``GET /api/updates?session=S[&after=T]``     score/timing series
 - ``GET /api/model?session=S``                 latest param/update stats
+- ``GET /metrics``                             unified registry (JSON;
+  Prometheus text with ``Accept: text/plain`` or ``?format=prometheus``)
+- ``GET /api/trace``                           Chrome trace-event JSON of
+  the process-global span tracer (loadable in Perfetto)
 
 Use::
 
@@ -77,6 +81,12 @@ select{margin-left:12px}
  <div class="card" id="phasecard" style="display:none">
    <h3>Phase timeline (per worker)</h3><svg id="phases"
     style="height:auto"></svg><div id="phaselegend" class="label"></div>
+ </div>
+</div>
+<div class="row">
+ <div class="card" id="tracecard" style="display:none">
+   <h3>Runtime trace (per thread, recent window)</h3><svg id="trace"
+    style="height:auto"></svg><div id="tracelegend" class="label"></div>
  </div>
 </div>
 <script>
@@ -157,6 +167,70 @@ async function refresh(){
   await refreshEmbedding(sess, m.embedding_version ?? null);
   await refreshFlow(sess, m.activation_stats || {});
   await refreshPhases(sess);
+  await refreshTrace();
+}
+const TRACE_PALETTE=["#1f77b4","#ff7f0e","#2ca02c","#d93025","#9334e6",
+  "#8c564b","#e377c2","#7f7f7f","#bcbd22","#12858d"];
+function spanColor(name){
+  let h = 0;
+  for (let i = 0; i < name.length; i++) h = (h*31 + name.charCodeAt(i))>>>0;
+  return TRACE_PALETTE[h % TRACE_PALETTE.length];
+}
+async function refreshTrace(){
+  // per-thread span lanes from the process-global tracer (/api/trace is
+  // the same Chrome trace-event payload Perfetto loads: "M" metadata
+  // events carry thread names, "X" events carry ts/dur in microseconds)
+  const t = await (await fetch("/api/trace")).json();
+  const evs = (t.traceEvents || []);
+  const names = {}, byTid = {};
+  evs.forEach(e=>{
+    if (e.ph === "M" && e.name === "thread_name")
+      names[e.tid] = e.args.name;
+    else if (e.ph === "X")
+      (byTid[e.tid] = byTid[e.tid] || []).push(e);
+  });
+  const tids = Object.keys(byTid).sort(
+    (a,b)=>(names[a]||a).localeCompare(names[b]||b));
+  const card = document.getElementById("tracecard");
+  if (!tids.length){ card.style.display = "none"; return; }
+  card.style.display = "";
+  // render only the recent window — the ring can hold 64k spans
+  let tmax = 0;
+  tids.forEach(tid=>byTid[tid].forEach(e=>{
+    tmax = Math.max(tmax, e.ts + e.dur); }));
+  const WINDOW_US = 10e6;
+  const tmin = Math.max(0, tmax - WINDOW_US);
+  const el = document.getElementById("trace");
+  const W = el.clientWidth || 760, LH = 30, P = 150, TP = 6;
+  const H = TP*2 + tids.length*LH + 16;
+  el.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  el.style.height = H + "px";
+  const sx = us=>P + (W - P - 10) * (us - tmin) / Math.max(tmax - tmin, 1);
+  let html = "";
+  const seen = new Set();
+  tids.forEach((tid, i)=>{
+    const y = TP + i*LH;
+    html += `<text x="${P-6}" y="${y+LH/2+3}" font-size="10"`+
+      ` text-anchor="end">${esc(names[tid] || ("thread-"+tid))}</text>`;
+    byTid[tid].forEach(e=>{
+      if (e.ts + e.dur < tmin) return;
+      seen.add(e.name);
+      const x0 = sx(Math.max(e.ts, tmin)), x1 = sx(e.ts + e.dur);
+      html += `<rect x="${x0.toFixed(1)}" y="${y+3}"`+
+        ` width="${Math.max(x1-x0, 0.8).toFixed(1)}" height="${LH-8}"`+
+        ` fill="${spanColor(e.name)}" fill-opacity="0.85">`+
+        `<title>${esc(e.name)} ${(e.dur/1000).toFixed(2)} ms</title>`+
+        `</rect>`;
+    });
+  });
+  html += `<text x="${P}" y="${H-2}" font-size="10" fill="#888">`+
+    `${(tmin/1e6).toFixed(2)}s</text>`+
+    `<text x="${W-60}" y="${H-2}" font-size="10" fill="#888">`+
+    `${(tmax/1e6).toFixed(2)}s</text>`;
+  el.innerHTML = html;
+  document.getElementById("tracelegend").innerHTML =
+    Array.from(seen).map(n=>`<span style="color:${spanColor(n)}">`+
+      `&#9632; ${esc(n)}</span>`).join(" &nbsp;");
 }
 async function refreshPhases(sess){
   // per-worker training-phase lanes (the Spark timeline tier): the
@@ -377,6 +451,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.flow_payload(q.get("session", "")))
         elif url.path == "/api/phases":
             self._json(ui.phases_payload(q.get("session", "")))
+        elif url.path == "/metrics":
+            from deeplearning4j_tpu.observability import metrics as om
+            if om.wants_prometheus(self.headers.get("Accept", ""),
+                                   url.query):
+                self._send(om.get_registry().render_prometheus().encode(),
+                           om.PROMETHEUS_CONTENT_TYPE)
+            else:
+                self._json(om.get_registry().snapshot())
+        elif url.path == "/api/trace":
+            from deeplearning4j_tpu.observability.trace import get_tracer
+            self._json(get_tracer().to_chrome_trace())
         else:
             self._json({"error": "not found"}, 404)
 
@@ -407,6 +492,11 @@ class UIServer:
         self._remote_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.ui_server = self  # type: ignore[attr-defined]
+        # /metrics serves the unified registry — make sure the runtime
+        # collector (compile count, device memory, steps/sec) is on it
+        from deeplearning4j_tpu.observability.metrics import (
+            install_runtime_metrics)
+        install_runtime_metrics()
         self.port = self._httpd.server_address[1]  # resolved if port=0
         self.host = host
         self._thread = threading.Thread(

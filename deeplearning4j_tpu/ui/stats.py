@@ -61,6 +61,15 @@ class StatsReport:
 
 
 def _array_stats(a: np.ndarray, histograms: bool, bins: int) -> dict:
+    if a.size == 0:
+        # zero-size tensors (scalar-free layers, an empty probe output)
+        # must produce a well-formed report, not a ValueError out of
+        # a.min()/np.histogram mid-training
+        out = {"mean": None, "std": None, "mean_magnitude": None,
+               "min": None, "max": None}
+        if histograms:
+            out["histogram"] = {"counts": [], "min": None, "max": None}
+        return out
     out = {
         "mean": float(a.mean()),
         "std": float(a.std()),

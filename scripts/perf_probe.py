@@ -19,13 +19,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from bench import _peak_flops, bench_host_loop, calibrated_step_time
+from bench import (_peak_flops, bench_host_loop, bench_trace_overhead,
+                   calibrated_step_time)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=["resnet50", "lenet", "char_rnn",
-                                       "mnist_mlp", "resnet18", "host_loop"])
+                                       "mnist_mlp", "resnet18", "host_loop",
+                                       "trace_overhead"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
@@ -36,7 +38,35 @@ def main():
                     help="host_loop: minibatches per epoch")
     ap.add_argument("--f32", action="store_true")
     ap.add_argument("--breakdown", action="store_true")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="record the probe run in the span tracer and "
+                    "export a Chrome trace-event file (open in Perfetto "
+                    "or chrome://tracing)")
     args = ap.parse_args()
+
+    tracer = None
+    if args.trace:
+        from deeplearning4j_tpu.observability.trace import Tracer, set_tracer
+        tracer = Tracer(enabled=True)
+        set_tracer(tracer)
+
+    def finish(out):
+        if tracer is not None:
+            tracer.export_chrome_trace(args.trace)
+            out["trace_file"] = args.trace
+            out["trace_spans"] = len(tracer.spans())
+        print(json.dumps(out))
+
+    if args.config == "trace_overhead":
+        # tracer on/off steps-per-sec guard (< 3% is the acceptance bar);
+        # bench_trace_overhead manages its own tracers, so --trace here
+        # only captures whatever the surrounding process recorded
+        batch = args.batch if args.batch != 256 else 1024
+        out = {"config": "trace_overhead"}
+        out.update(bench_trace_overhead(
+            batch=batch, n_batches=args.n_batches, epochs=args.epochs))
+        finish(out)
+        return
 
     if args.config == "host_loop":
         # the fit-loop round: steps/sec through net.fit with the device
@@ -46,7 +76,7 @@ def main():
         out = {"config": "host_loop"}
         out.update(bench_host_loop(batch=batch, n_batches=args.n_batches,
                                    epochs=args.epochs))
-        print(json.dumps(out))
+        finish(out)
         return
 
     import jax
@@ -132,7 +162,7 @@ def main():
     except Exception as e:
         out["cost_error"] = repr(e)
 
-    print(json.dumps(out))
+    finish(out)
 
 
 if __name__ == "__main__":
