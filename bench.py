@@ -227,6 +227,76 @@ def bench_trace_overhead(batch: int = 1024, n_batches: int = 32,
     }
 
 
+def bench_input_pipeline(batch: int = 1024, n_batches: int = 32,
+                         epochs: int = 4) -> dict:
+    """Input-pipeline round: full ``net.fit`` steps/sec and records/sec
+    through a datapipe Pipeline (shuffle window + batch + worker
+    prefetch) vs the bare ``ArrayDataSetIterator`` gather — plus the
+    pipeline's own stall fraction (consumer wall-clock blocked on data)
+    and the checkpointing overhead question: the same run with pipeline
+    metrics/spans attached must stay within the observability budget
+    (< 3%). Uses the mnist MLP + best-of-2 fit_time like the host_loop
+    entry so the three host-side rounds stay comparable."""
+    from deeplearning4j_tpu import datapipe, zoo
+    from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.observability.trace import Tracer, set_tracer
+
+    rng = np.random.default_rng(0)
+    n = batch * n_batches
+    x = rng.normal(size=(n, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    steps = epochs * n_batches
+
+    def make_pipe():
+        return (datapipe.from_arrays(x, y)
+                .shuffle(window=4 * batch, seed=0)
+                .batch(batch, drop_last=True)
+                .prefetch(2))
+
+    def fit_time(net, source):
+        net.fit(source, epochs=1)         # warm-up: compile + stragglers
+        float(net.score_value)
+        best = float("inf")
+        for _ in range(2):                # best-of-2: shave scheduler noise
+            if not getattr(source, "auto_epochs", False):
+                source.reset()
+            t0 = time.perf_counter()
+            net.fit(source, epochs=epochs)
+            float(net.score_value)        # execution barrier
+            best = min(best, time.perf_counter() - t0)
+        return best / steps
+
+    bare_it = ArrayDataSetIterator(x, y, batch_size=batch, shuffle=True,
+                                   seed=0, drop_last=True)
+    bare = fit_time(zoo.mnist_mlp(), bare_it)
+
+    prev = set_tracer(Tracer(enabled=False))
+    try:
+        pipe_off = make_pipe()
+        piped_off = fit_time(zoo.mnist_mlp(), pipe_off)
+        pipe_off.close()
+        set_tracer(Tracer(enabled=True))  # spans + metrics collectors live
+        pipe_on = make_pipe()
+        piped_on = fit_time(zoo.mnist_mlp(), pipe_on)
+        snap = pipe_on.stats.snapshot()
+        pipe_on.close()
+    finally:
+        set_tracer(prev)
+    obs_pct = (piped_on - piped_off) / piped_off * 100.0
+    return {
+        "batch": batch,
+        "steps_timed": steps,
+        "bare_steps_per_sec": round(1.0 / bare, 1),
+        "pipeline_steps_per_sec": round(1.0 / piped_off, 1),
+        "bare_records_per_sec": round(batch / bare, 1),
+        "pipeline_records_per_sec": round(batch / piped_off, 1),
+        "pipeline_vs_bare_pct": round((piped_off - bare) / bare * 100.0, 2),
+        "stall_fraction": round(snap["stall_fraction"], 4),
+        "observability_overhead_pct": round(obs_pct, 3),
+        "observability_overhead_ok": obs_pct < 3.0,
+    }
+
+
 def run_config(name: str) -> dict:
     """Build + time one named config (runs inside its own process)."""
     from deeplearning4j_tpu import zoo
@@ -236,6 +306,8 @@ def run_config(name: str) -> dict:
         return bench_host_loop()
     if name == "trace_overhead":
         return bench_trace_overhead()
+    if name == "input_pipeline":
+        return bench_input_pipeline()
     if name == "mnist_mlp":
         return _bench_net(
             zoo.mnist_mlp(),
@@ -297,7 +369,7 @@ def run_config(name: str) -> dict:
 
 
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
-            "serving", "host_loop", "trace_overhead")
+            "serving", "host_loop", "trace_overhead", "input_pipeline")
 
 
 def main():

@@ -69,14 +69,20 @@ class ArrayDataSetIterator(DataSetIterator):
         self.labels = np.asarray(labels) if labels is not None else None
         self._batch = int(batch_size)
         self._shuffle = shuffle
-        self._rng = np.random.default_rng(seed)
+        self._seed = int(seed)
+        self._epoch = 0
         self._drop_last = drop_last
 
     def __iter__(self):
         n = self.features.shape[0]
         idx = np.arange(n)
         if self._shuffle:
-            self._rng.shuffle(idx)
+            # fresh-but-deterministic order each epoch (seed + epoch, the
+            # SamplingDataSetIterator scheme) so reset() makes replay after
+            # a rollback bit-identical instead of consuming a shared
+            # mutating RNG
+            np.random.default_rng(self._seed + self._epoch).shuffle(idx)
+        self._epoch += 1
         stop = (n // self._batch) * self._batch if self._drop_last else n
         for start in range(0, stop, self._batch):
             sel = idx[start:start + self._batch]
@@ -88,6 +94,12 @@ class ArrayDataSetIterator(DataSetIterator):
     def __len__(self):
         n = self.features.shape[0]
         return n // self._batch if self._drop_last else -(-n // self._batch)
+
+    def reset(self):
+        """Restart the stream: replay yields the epoch-0 order again (the
+        DataSetIterator contract — previously a no-op while the RNG kept
+        mutating, so post-rollback replays saw different orders)."""
+        self._epoch = 0
 
     @property
     def batch_size(self):
@@ -236,11 +248,20 @@ class MultipleEpochsIterator(DataSetIterator):
     def __init__(self, epochs: int, base: DataSetIterator):
         self.epochs = epochs
         self.base = base
+        self._epoch = 0
 
     def __iter__(self):
-        for _ in range(self.epochs):
-            self.base.reset()
+        # no base.reset() between epochs: bases with seed+epoch shuffle
+        # (ArrayDataSetIterator, SamplingDataSetIterator) advance their
+        # epoch counter naturally, so each replayed epoch sees a distinct
+        # deterministic order; reset() rewinds everything to epoch 0
+        while self._epoch < self.epochs:
+            self._epoch += 1
             yield from self.base
+
+    def reset(self):
+        self._epoch = 0
+        self.base.reset()
 
     @property
     def batch_size(self):
@@ -297,6 +318,12 @@ class NativeDataSetIterator(DataSetIterator):
     def close(self):
         self._loader.close()
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
 
 class SamplingDataSetIterator(DataSetIterator):
     """Batches sampled WITH replacement from a source DataSet
@@ -346,8 +373,11 @@ class ReconstructionDataSetIterator(DataSetIterator):
         self.base = base
 
     def __iter__(self):
+        # the features mask applies to both sides of reconstruction:
+        # masked sequence autoencoders must not score padded steps
         for ds in self.base:
-            yield DataSet(ds.features, ds.features)
+            yield DataSet(ds.features, ds.features,
+                          ds.features_mask, ds.features_mask)
 
     def reset(self):
         self.base.reset()

@@ -25,15 +25,18 @@ class CSVRecordReader:
         self.skip_lines = skip_lines
         self.delimiter = delimiter
 
-    def records(self) -> List[List[float]]:
-        out = []
+    def iter_records(self):
+        """Stream rows one at a time without materializing the file —
+        the datapipe CSVSource path (resume state stays one cursor)."""
         with open(self.path, newline="") as f:
             reader = csv.reader(f, delimiter=self.delimiter)
             for i, row in enumerate(reader):
                 if i < self.skip_lines or not row:
                     continue
-                out.append([float(v) for v in row])
-        return out
+                yield [float(v) for v in row]
+
+    def records(self) -> List[List[float]]:
+        return list(self.iter_records())
 
 
 class CollectionRecordReader:

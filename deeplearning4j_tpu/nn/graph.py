@@ -795,7 +795,11 @@ class ComputationGraph:
                     self.fit_batch(d)
             _obs_metrics.observe_step(self.iteration - it0,
                                       time.perf_counter() - t0)
-            if hasattr(data, "reset"):
+            if hasattr(data, "reset") and not getattr(data, "auto_epochs",
+                                                      False):
+                # datapipe Pipelines advance their own epoch state
+                # (seed + epoch shuffle orders); reset() would rewind
+                # them to epoch 0 every pass
                 data.reset()
             for l in self.listeners:
                 l.on_epoch_end(self)

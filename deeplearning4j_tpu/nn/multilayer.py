@@ -631,7 +631,11 @@ class MultiLayerNetwork:
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
-            it.reset()
+            if not getattr(it, "auto_epochs", False):
+                # datapipe Pipelines advance their own epoch state
+                # (seed + epoch shuffle orders); reset() would rewind
+                # them to epoch 0 every pass
+                it.reset()
         return self
 
     _FIT_CHUNK_DEFAULT = 8

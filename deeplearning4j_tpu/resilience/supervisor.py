@@ -225,6 +225,10 @@ class TrainingSupervisor:
         self.events: List[RecoveryEvent] = []
         self._preempt_requested = False
         self._last_good: Optional[str] = None
+        #: datapipe.Pipeline being supervised (fit_pipeline): its
+        #: state_dict rides in every checkpoint's meta.json and is
+        #: restored alongside the net on resume/rollback
+        self._pipeline = None
         self._lr_scale0 = getattr(net, "_lr_scale", 1.0)
         #: async checkpoint writer state: at most ONE write in flight
         self._ckpt_thread: Optional[threading.Thread] = None
@@ -278,9 +282,16 @@ class TrainingSupervisor:
         tracer = _get_tracer()
         self._drain_checkpoint()
         path = self._step_dir(step)
+        # pipeline state is captured HERE on the main thread — in the
+        # async path the background writer gets plain data, consistent
+        # with the device snapshot taken at the same step boundary
+        extra = None
+        if self._pipeline is not None:
+            extra = {"datapipe": self._pipeline.state_dict()}
         if not cfg.async_checkpoints:
             with tracer.span("checkpoint_write", step=step, reason=reason):
-                save_checkpoint(self.net, path, stats=self.stats_collector)
+                save_checkpoint(self.net, path, stats=self.stats_collector,
+                                extra_meta=extra)
                 self._write_latest_pointer(path)
             self._commit_checkpoint(step, reason, path)
             return path
@@ -295,7 +306,8 @@ class TrainingSupervisor:
             try:
                 with tracer.span("checkpoint_write", step=step,
                                  reason=reason):
-                    save_checkpoint(snap, path, stats=self.stats_collector)
+                    save_checkpoint(snap, path, stats=self.stats_collector,
+                                    extra_meta=extra)
                     self._write_latest_pointer(path)
             except BaseException as e:  # kept for the drain barrier
                 pending["error"] = e
@@ -387,6 +399,16 @@ class TrainingSupervisor:
         net.iteration = restored.iteration
         net.epoch = restored.epoch
         self._last_good = path
+        if self._pipeline is not None:
+            from deeplearning4j_tpu.utils.checkpoint import (
+                read_checkpoint_meta)
+            meta = read_checkpoint_meta(path)
+            if "datapipe" in meta:
+                self._pipeline.load_state_dict(meta["datapipe"])
+            else:
+                logger.warning(
+                    "checkpoint %s carries no datapipe state; the pipeline "
+                    "keeps its current position", path)
 
     # ------------------------------------------------------------- stepping
     def request_preemption(self):
@@ -572,13 +594,146 @@ class TrainingSupervisor:
             resumed_from=resumed_from, events=list(self.events),
             stats=self.stats.snapshot())
 
+    # ------------------------------------------------------- pipeline loop
+    def fit_pipeline(self, pipeline, *, epochs: int = 1) -> SupervisorResult:
+        """Supervise training over a ``datapipe.Pipeline`` — the
+        streaming-source twin of :meth:`run`. The pipeline's
+        ``state_dict()`` rides in every checkpoint's ``meta.json``
+        (captured at the same step boundary as the device snapshot), so
+        resume and NaN rollback restore DATA position — epoch, source
+        cursor, shuffle RNG + window, partial batch buffers, prefetched
+        batches — alongside the parameters: a killed-and-relaunched run
+        consumes the exact record sequence an uninterrupted one would,
+        and final params are bit-identical even from a shuffled or
+        streaming source. Completion is data-driven (the stream runs out
+        of epochs) rather than an absolute target step."""
+        cfg = self.config
+        net = self.net
+        self._pipeline = pipeline
+        resumed_from = None
+
+        from deeplearning4j_tpu.utils.checkpoint import (
+            find_latest_checkpoint)
+        _obs_metrics.install_runtime_metrics()
+        self.stats.attach_to_registry(
+            labels={"job": os.path.basename(
+                os.path.normpath(cfg.checkpoint_dir))})
+
+        if cfg.resume:
+            latest = find_latest_checkpoint(cfg.checkpoint_dir)
+            if latest is not None:
+                with _get_tracer().span("restore"):
+                    self._load_into(latest)
+                self._emit("resume", net.iteration,
+                           f"restored {latest} (datapipe epoch "
+                           f"{pipeline.epoch})", counter="resumes")
+                resumed_from = latest
+
+        old_handler = None
+        use_signal = (cfg.handle_sigterm
+                      and threading.current_thread()
+                      is threading.main_thread())
+        if use_signal:
+            old_handler = signal.signal(signal.SIGTERM, self._sigterm)
+        stream = None
+
+        def invalidate_stream():
+            # close the live generator chain FIRST (stops prefetch
+            # workers mid-pull) so a restore never races a worker still
+            # mutating upstream stage state
+            nonlocal stream
+            if stream is not None:
+                stream.close()
+                stream = None
+
+        try:
+            if self._last_good is None:
+                # baseline save: rollback target from the very first
+                # step, now including the pipeline's start-of-run state
+                self._checkpoint(net.iteration, "baseline")
+
+            rollbacks = 0
+            status = "completed"
+            while True:
+                if self._preempt_requested:
+                    status = "preempted"
+                    break
+                if stream is None:
+                    stream = pipeline.stream(epochs)
+                ds = next(stream, None)
+                if ds is None:
+                    # stream exhausted — but the lazy-score tail may hold
+                    # poison; a rollback rewinds data position too and
+                    # re-enters the loop with a rebuilt stream
+                    bad = self._flush_nan_checks()
+                    if bad is not None:
+                        rollbacks += 1
+                        invalidate_stream()
+                        self._rollback(bad[0], bad[1], rollbacks)
+                        continue
+                    break
+                step = net.iteration
+                score = self._attempt_step(ds, step)
+                if cfg.nan_check_every > 0:
+                    self._pending_scores.append((step, score))
+                due_check = (cfg.nan_check_every > 0
+                             and net.iteration % cfg.nan_check_every == 0)
+                due_ckpt = net.iteration % cfg.checkpoint_every_steps == 0
+                if (due_check or due_ckpt) and self._pending_scores:
+                    bad = self._flush_nan_checks()
+                    if bad is not None:
+                        rollbacks += 1
+                        invalidate_stream()
+                        self._rollback(bad[0], bad[1], rollbacks)
+                        continue
+                if due_ckpt:
+                    self._checkpoint(net.iteration, "periodic")
+
+            if status == "preempted":
+                bad = self._flush_nan_checks()
+                if bad is not None:
+                    rollbacks += 1
+                    invalidate_stream()
+                    self._rollback(bad[0], bad[1], rollbacks)
+                # park the prefetch workers so the saved pipeline state
+                # is the final word on data position
+                invalidate_stream()
+                self._checkpoint(net.iteration, "preemption", wait=True)
+                self._emit("preempt", net.iteration,
+                           f"clean exit at step {net.iteration} (datapipe "
+                           f"epoch {pipeline.epoch} of {epochs})",
+                           counter="preemptions")
+            else:
+                self._drain_checkpoint()  # settle _last_good first
+                if self._last_good != self._step_dir(net.iteration):
+                    self._checkpoint(net.iteration, "final", wait=True)
+        finally:
+            if use_signal:
+                signal.signal(signal.SIGTERM, old_handler)
+            invalidate_stream()
+            # the pipeline reports only while consumed: detach its
+            # collector so back-to-back runs over fresh pipeline objects
+            # don't accumulate stale families in the global registry
+            pipeline.stats.detach_from_registry()
+            self._drain_checkpoint(raise_errors=False)
+
+        return SupervisorResult(
+            status=status, final_step=net.iteration,
+            resumed_from=resumed_from, events=list(self.events),
+            stats=self.stats.snapshot())
+
     # ----------------------------------------------------------- fit facade
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 32) -> SupervisorResult:
         """The ``fit``-shaped entry: materializes the batch sequence and
         supervises to the absolute step ``epochs * len(batches)`` —
         absolute so a killed-and-relaunched run lands on the SAME final
-        step count as an uninterrupted one."""
+        step count as an uninterrupted one. A ``datapipe.Pipeline``
+        dispatches to :meth:`fit_pipeline` instead (streaming, never
+        materialized; data position checkpointed)."""
+        from deeplearning4j_tpu.datapipe.core import Pipeline
+        if isinstance(data, Pipeline):
+            return self.fit_pipeline(data, epochs=epochs)
         batches = _materialize_batches(data, labels, batch_size)
         if not batches:
             raise ValueError("no training batches")

@@ -110,7 +110,7 @@ def snapshot_for_checkpoint(net) -> CheckpointSnapshot:
         iteration=int(net.iteration), epoch=int(net.epoch))
 
 
-def save_checkpoint(net, path: str, stats=None):
+def save_checkpoint(net, path: str, stats=None, extra_meta=None):
     """Write {config, params, state, opt_state, step, epoch} under
     ``path`` (a directory). In a multi-process runtime every process must
     call this (orbax coordinates the parallel shard writes).
@@ -124,14 +124,20 @@ def save_checkpoint(net, path: str, stats=None):
 
     ``stats``: optional parallel.stats.TrainingStatsCollector — records
     the whole save (shard writes + cross-process barrier) as a
-    ``checkpoint_barrier`` EventStats phase for the training timeline."""
+    ``checkpoint_barrier`` EventStats phase for the training timeline.
+
+    ``extra_meta``: optional JSON-serializable dict merged into
+    ``meta.json`` (reserved keys rejected) — the seam the resilience
+    supervisor uses to make input-pipeline position
+    (``Pipeline.state_dict()``, key ``"datapipe"``) part of the
+    checkpoint."""
     if stats is not None:
         with stats.time_phase("checkpoint_barrier"):
-            return _save_checkpoint_inner(net, path)
-    return _save_checkpoint_inner(net, path)
+            return _save_checkpoint_inner(net, path, extra_meta)
+    return _save_checkpoint_inner(net, path, extra_meta)
 
 
-def _save_checkpoint_inner(net, path: str):
+def _save_checkpoint_inner(net, path: str, extra_meta=None):
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     tree = {"params": net.params, "state": net.state or {},
@@ -148,6 +154,12 @@ def _save_checkpoint_inner(net, path: str):
             "epoch": int(net.epoch),
             "format_version": 1,
         }
+        if extra_meta:
+            clash = set(extra_meta) & set(meta)
+            if clash:
+                raise ValueError(f"extra_meta may not override reserved "
+                                 f"meta.json keys: {sorted(clash)}")
+            meta.update(extra_meta)
         tmp = os.path.join(path, ".meta.json.tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f)
@@ -163,6 +175,14 @@ def _save_checkpoint_inner(net, path: str):
 
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def read_checkpoint_meta(path: str) -> dict:
+    """The checkpoint's ``meta.json`` dict (counters, config, plus any
+    ``extra_meta`` a save recorded — e.g. the supervisor's ``datapipe``
+    pipeline state)."""
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)
 
 
 def is_valid_checkpoint(path: str) -> bool:

@@ -1,0 +1,208 @@
+"""Chaos datapipe demo: a SHUFFLED STREAMING input pipeline survives a
+hostile schedule of injected failures and still lands on BIT-IDENTICAL
+final parameters vs an uninterrupted run.
+
+The harder twin of scripts/chaos_train.py: there the batch sequence is a
+pure function of the step counter, so checkpointing the model was
+enough. Here the data comes through a datapipe Pipeline — records stream
+from a CSV file on disk, pass a windowed shuffle whose order depends on
+RNG state, get batched, and are prefetched by a worker thread — so
+"which record comes next" is pipeline STATE, not a function of the step
+number. The supervisor now checkpoints that state too
+(``Pipeline.state_dict()`` inside each checkpoint's ``meta.json``), and
+this script proves the property end to end:
+
+1. **Reference** — one uninterrupted supervised run over the pipeline.
+2. **Chaos** — the same run, but each launch arms one fault (crash
+   between the checkpoint tree commit and its ``meta.json`` rename,
+   transient step errors, clean preemption mid-epoch) and every relaunch
+   builds a FRESH net and a FRESH pipeline object: resume of both model
+   and data position must come entirely from disk.
+3. **Verdict** — every parameter array compared bit-for-bit
+   (``np.testing.assert_array_equal``): a resume that replayed or
+   skipped even one shuffled record would fail.
+
+Run: ``python scripts/chaos_pipeline.py`` (CPU is fine, ~30s). The
+pytest variant is ``tests/test_datapipe.py::test_chaos_resume_*``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # F64 policy, like the tests
+
+
+def build_net(seed):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    f64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .dtype(f64).list()
+            .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def write_csv(path, seed, n_rows):
+    """Label-first numeric CSV — the streaming source of truth on disk."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_rows):
+            row = [rng.integers(0, 4)] + list(rng.normal(size=12))
+            f.write(",".join(f"{v:.17g}" for v in row) + "\n")
+
+
+def build_pipeline(csv_path, batch_size, seed):
+    """Fresh pipeline object per launch: streaming CSV -> windowed
+    shuffle -> batch -> worker prefetch. Every stage holds resumable
+    state (cursor, RNG + window, partial buffers, prefetched batches)."""
+    from deeplearning4j_tpu import datapipe
+    return (datapipe.from_csv(csv_path, label_index=0, num_classes=4)
+            .shuffle(window=4 * batch_size, seed=seed)
+            .batch(batch_size, drop_last=True)
+            .prefetch(2))
+
+
+def flat_params(net):
+    return {(n, k): np.asarray(v) for n, sub in net.params.items()
+            for k, v in sub.items()}
+
+
+def chaos_schedule(rows, batch_size):
+    """Faults armed per launch. The preemption lands mid-epoch by
+    construction (half-way through an epoch's batch count), which is the
+    interesting case: resume must restart inside a half-consumed shuffle
+    window. Deterministic, so reruns behave identically."""
+    per_epoch = rows // batch_size
+    return [
+        [("crash_save", 1)],                         # kill the 2nd save
+        [("transient", per_epoch + 1),               # retried in place...
+         ("preempt", per_epoch + per_epoch // 2)],   # ...then die mid-epoch
+        [("crash_save", 1)],                         # kill a save again
+        [],                                          # clean final launch
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--rows", type=int, default=96,
+                    help="CSV rows (default 96)")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--checkpoint-every", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--dir", default=None,
+                    help="work directory (default: fresh tempdir)")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.resilience import (FaultInjector, InjectedCrash,
+                                               SupervisorConfig,
+                                               TrainingSupervisor)
+
+    work = args.dir or tempfile.mkdtemp(prefix="chaos_pipeline_")
+    os.makedirs(work, exist_ok=True)
+    csv_path = os.path.join(work, "train.csv")
+    write_csv(csv_path, args.seed, args.rows)
+
+    def config(ckpt_dir):
+        return SupervisorConfig(checkpoint_dir=ckpt_dir,
+                                checkpoint_every_steps=args.checkpoint_every,
+                                backoff_initial_s=0.01,
+                                handle_sigterm=False)
+
+    # ------------------------------------------------ 1. reference run
+    steps = args.epochs * (args.rows // args.batch_size)
+    print(f"[reference] {args.epochs} uninterrupted epochs "
+          f"({steps} steps) over the streaming pipeline ...")
+    t0 = time.perf_counter()
+    ref = build_net(args.seed)
+    ref_dir = os.path.join(work, "ckpt_ref")
+    res = TrainingSupervisor(ref, config(ref_dir)).fit(
+        build_pipeline(csv_path, args.batch_size, args.seed),
+        epochs=args.epochs)
+    assert res.status == "completed" and res.final_step == steps
+    print(f"[reference] done in {time.perf_counter() - t0:.1f}s "
+          f"(final score {float(ref.score_value):.4f})")
+
+    # ---------------------------------------------------- 2. chaos run
+    schedule = chaos_schedule(args.rows, args.batch_size)
+    n_faults = sum(len(launch) for launch in schedule)
+    ckpt_dir = os.path.join(work, "ckpt_chaos")
+    print(f"\n[chaos] {args.epochs} epochs, checkpoint every "
+          f"{args.checkpoint_every}, dir {ckpt_dir}")
+    launches, net, result = 0, None, None
+    totals = {}
+    while True:
+        launches += 1
+        injector = FaultInjector()
+        for fault, at in schedule[min(launches - 1, len(schedule) - 1)]:
+            if fault == "crash_save":
+                injector.crash_during_save(at)
+            elif fault == "transient":
+                injector.fail_step(at, times=2)
+            elif fault == "preempt":
+                injector.preempt_at_step(at)
+
+        # fresh net AND fresh pipeline: model and data position both
+        # resume from disk, exactly like a new process would
+        net = build_net(args.seed)
+        pipe = build_pipeline(csv_path, args.batch_size, args.seed)
+        sup = TrainingSupervisor(net, config(ckpt_dir), injector=injector)
+        try:
+            with injector.installed():
+                result = sup.fit(pipe, epochs=args.epochs)
+        except InjectedCrash as e:
+            print(f"[chaos] launch {launches}: KILLED mid-save ({e}) at "
+                  f"step {net.iteration} — relaunching")
+            for k, v in sup.stats.snapshot().items():
+                totals[k] = totals.get(k, 0) + v
+            continue
+        for k, v in result.stats.items():
+            totals[k] = totals.get(k, 0) + v
+        if result.status == "preempted":
+            print(f"[chaos] launch {launches}: preempted cleanly at step "
+                  f"{result.final_step} (datapipe epoch {pipe.epoch}) "
+                  "— relaunching")
+            continue
+        print(f"[chaos] launch {launches}: completed at step "
+              f"{result.final_step}"
+              + (f" (resumed from {os.path.basename(result.resumed_from)})"
+                 if result.resumed_from else ""))
+        break
+
+    # ------------------------------------------------------ 3. verdict
+    assert result.final_step == steps, (result.final_step, steps)
+    pr, pc = flat_params(ref), flat_params(net)
+    assert pr.keys() == pc.keys()
+    for key in pr:
+        np.testing.assert_array_equal(pr[key], pc[key],
+                                      err_msg=f"param {key} diverged")
+
+    print(f"\n[verdict] PASS — {launches} launches "
+          f"({n_faults} injected faults, shuffled streaming source), "
+          f"final step {result.final_step}, all {len(pr)} parameter "
+          "arrays BIT-IDENTICAL to the uninterrupted run")
+    print("[stats]  " + "  ".join(f"{k}={v}" for k, v in sorted(
+        totals.items()) if v))
+    if not args.dir:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

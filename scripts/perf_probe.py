@@ -19,15 +19,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from bench import (_peak_flops, bench_host_loop, bench_trace_overhead,
-                   calibrated_step_time)
+from bench import (_peak_flops, bench_host_loop, bench_input_pipeline,
+                   bench_trace_overhead, calibrated_step_time)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=["resnet50", "lenet", "char_rnn",
                                        "mnist_mlp", "resnet18", "host_loop",
-                                       "trace_overhead"])
+                                       "trace_overhead", "input_pipeline"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
@@ -64,6 +64,17 @@ def main():
         batch = args.batch if args.batch != 256 else 1024
         out = {"config": "trace_overhead"}
         out.update(bench_trace_overhead(
+            batch=batch, n_batches=args.n_batches, epochs=args.epochs))
+        finish(out)
+        return
+
+    if args.config == "input_pipeline":
+        # the datapipe round: records/sec + stall fraction through a
+        # shuffle/batch/prefetch pipeline vs the bare in-memory gather,
+        # and the pipeline's metrics/spans overhead (< 3% budget)
+        batch = args.batch if args.batch != 256 else 1024
+        out = {"config": "input_pipeline"}
+        out.update(bench_input_pipeline(
             batch=batch, n_batches=args.n_batches, epochs=args.epochs))
         finish(out)
         return

@@ -1,0 +1,521 @@
+"""Checkpointable input-pipeline tests (datapipe/): sharding is proved
+disjoint + covering by property sweep, mid-epoch ``state_dict`` resume is
+proved bit-identical at the record level AND end-to-end through the
+resilience supervisor over a shuffled streaming source (the chaos test),
+and the satellites in ``datasets/iterator.py`` are pinned.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import datapipe
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    ArrayDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.resilience import (
+    FaultInjector,
+    InjectedCrash,
+    SupervisorConfig,
+    TrainingSupervisor,
+)
+from deeplearning4j_tpu.utils.checkpoint import (
+    read_checkpoint_meta,
+    save_checkpoint,
+)
+
+F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+
+
+def _mln(seed=3, n_in=5, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .dtype(F64).list()
+            .layer(Dense(n_in=n_in, n_out=7, activation="tanh"))
+            .layer(Output(n_out=n_out, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _params(net):
+    return {(n, k): np.asarray(v) for n, sub in net.params.items()
+            for k, v in sub.items()}
+
+
+def _assert_params_equal(a, b):
+    pa, pb = _params(a), _params(b)
+    assert pa.keys() == pb.keys()
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pb[k])
+
+
+def _arrays(n=24, f=5, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, f)), np.eye(c)[rng.integers(0, c, n)]
+
+
+def _write_csv(path, n=48, f=5, c=3, seed=7):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as fh:
+        for _ in range(n):
+            row = [rng.integers(0, c)] + list(rng.normal(size=f))
+            fh.write(",".join(f"{v:.17g}" for v in row) + "\n")
+
+
+def _batches(pipe):
+    return [(np.asarray(ds.features), np.asarray(ds.labels)) for ds in pipe]
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+# ---------------------------------------------------------------------------
+# Shuffle: determinism, per-epoch orders, coverage
+# ---------------------------------------------------------------------------
+
+def test_shuffle_epochs_deterministic_distinct_and_covering():
+    x, y = _arrays(n=30)
+    make = lambda: datapipe.from_arrays(x, y).shuffle(window=8, seed=5).batch(6)
+    p1, p2 = make(), make()
+    e0a, e0b = _batches(p1), _batches(p2)
+    _assert_batches_equal(e0a, e0b)            # same seed -> same order
+    e1 = _batches(p1)
+    assert not all(np.array_equal(a[0], b[0]) for a, b in zip(e0a, e1))
+    # every epoch is a permutation: full coverage, nothing replayed
+    for epoch in (e0a, e1):
+        feats = np.concatenate([b[0] for b in epoch])
+        assert feats.shape == x.shape
+        np.testing.assert_array_equal(
+            np.sort(feats, axis=0), np.sort(x, axis=0))
+
+
+def test_pipeline_reset_replays_epoch0():
+    x, y = _arrays()
+    pipe = datapipe.from_arrays(x, y).shuffle(window=8, seed=1).batch(4)
+    e0 = _batches(pipe)
+    _batches(pipe)                              # consume epoch 1
+    pipe.reset()
+    assert pipe.epoch == 0
+    _assert_batches_equal(e0, _batches(pipe))
+
+
+# ---------------------------------------------------------------------------
+# Mid-epoch checkpoint/resume at the record level
+# ---------------------------------------------------------------------------
+
+def _pipe_variants(csv_path):
+    x, y = _arrays(n=36)
+    return {
+        "shuffle_batch": lambda: (datapipe.from_arrays(x, y)
+                                  .shuffle(window=10, seed=3).batch(4)),
+        "csv_stream": lambda: (datapipe.from_csv(csv_path, label_index=0,
+                                                 num_classes=3)
+                               .shuffle(window=12, seed=9)
+                               .batch(5)),
+        "prefetch": lambda: (datapipe.from_arrays(x, y)
+                             .shuffle(window=10, seed=3).batch(4)
+                             .prefetch(2)),
+        "map_filter": lambda: (datapipe.from_arrays(x, y)
+                               .filter(lambda r: float(r[0][0]) > -2.0)
+                               .map(lambda r: (r[0] * 2.0, r[1]))
+                               .shuffle(window=6, seed=1).batch(3)),
+    }
+
+
+@pytest.mark.parametrize("variant", ["shuffle_batch", "csv_stream",
+                                     "prefetch", "map_filter"])
+def test_mid_epoch_state_roundtrip_bit_identical(tmp_path, variant):
+    csv = str(tmp_path / "rows.csv")
+    _write_csv(csv, n=36)
+    make = _pipe_variants(csv)[variant]
+
+    ref = make()
+    full = _batches(ref) + _batches(ref)        # two full epochs
+    ref.close()
+
+    pipe = make()
+    it = iter(pipe)
+    got = [next(it) for _ in range(3)]          # stop mid-epoch 0
+    state = pipe.state_dict()
+    state = json.loads(json.dumps(state))       # must survive meta.json
+    pipe.close()
+
+    resumed = make()
+    resumed.load_state_dict(state)
+    # remainder of epoch 0 then all of epoch 1 must match the unbroken run
+    rest = []
+    while resumed.epoch < 2:
+        rest.extend(_batches(resumed))
+    resumed.close()
+    got_all = [(np.asarray(d.features), np.asarray(d.labels)) for d in got]
+    _assert_batches_equal(full, got_all + rest)
+
+
+def test_state_dict_is_o_window_not_o_dataset():
+    x, y = _arrays(n=2000, f=4)
+    pipe = datapipe.from_arrays(x, y).shuffle(window=16, seed=0).batch(8)
+    it = iter(pipe)
+    next(it)
+    small = len(json.dumps(pipe.state_dict()))
+    x2, y2 = _arrays(n=4000, f=4)
+    pipe2 = datapipe.from_arrays(x2, y2).shuffle(window=16, seed=0).batch(8)
+    it2 = iter(pipe2)
+    next(it2)
+    # doubling the dataset must not grow the state (same window/buffers)
+    assert abs(len(json.dumps(pipe2.state_dict())) - small) < 200
+
+
+def test_load_state_rejects_mismatched_stage_sequence():
+    x, y = _arrays()
+    state = datapipe.from_arrays(x, y).shuffle(window=4, seed=0).state_dict()
+    other = datapipe.from_arrays(x, y).batch(4)
+    with pytest.raises(ValueError, match="stage"):
+        other.load_state_dict(state)
+
+
+# ---------------------------------------------------------------------------
+# Sharding: disjoint + covering, any size, stable under resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 7, 20, 33])
+@pytest.mark.parametrize("num_shards", [1, 2, 3, 4, 7])
+def test_shard_disjoint_and_covering(n, num_shards):
+    x = np.arange(n, dtype=np.float64).reshape(n, 1)
+    seen = []
+    for i in range(num_shards):
+        pipe = datapipe.from_arrays(x).shard(num_shards, i)
+        vals = [int(ds.features[0, 0]) for ds in pipe]
+        seen.append(set(vals))
+        assert len(vals) == len(set(vals))      # no duplicates in a shard
+    union = set().union(*seen)
+    assert union == set(range(n))               # covering
+    assert sum(len(s) for s in seen) == n       # disjoint
+    # balanced to within one record, including non-divisible sizes
+    sizes = sorted(len(s) for s in seen)
+    assert sizes[-1] - sizes[0] <= 1
+
+
+def test_shard_stable_under_mid_epoch_resume():
+    x = np.arange(23, dtype=np.float64).reshape(23, 1)
+    make = lambda: datapipe.from_arrays(x).shard(3, 1)
+    full = [int(ds.features[0, 0]) for ds in make()]
+    pipe = make()
+    it = iter(pipe)
+    head = [int(next(it).features[0, 0]) for _ in range(2)]
+    state = pipe.state_dict()
+    resumed = make()
+    resumed.load_state_dict(state)
+    tail = [int(ds.features[0, 0]) for ds in resumed]
+    assert head + tail == full
+
+
+# ---------------------------------------------------------------------------
+# Transforms: normalize, bucket batching masks
+# ---------------------------------------------------------------------------
+
+def test_normalize_standardizes_and_checkpoints_stats():
+    rng = np.random.default_rng(4)
+    x = rng.normal(loc=5.0, scale=3.0, size=(64, 4))
+    pipe = datapipe.from_arrays(x).normalize().batch(64)
+    feats = np.asarray(next(iter(pipe)).features)
+    np.testing.assert_allclose(feats.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(feats.std(axis=0), 1.0, atol=1e-2)
+    # the fitted stats travel in the checkpoint state
+    state = json.loads(json.dumps(pipe.state_dict()))
+    fresh = datapipe.from_arrays(x).normalize(
+        stats=datapipe.NormalizerStats(np.zeros(4), np.ones(4))).batch(64)
+    fresh.load_state_dict(state)
+    fresh.reset()          # rewind position; the loaded moments survive
+    np.testing.assert_allclose(
+        np.asarray(next(iter(fresh)).features), feats, rtol=1e-12)
+
+
+def test_bucket_batch_pads_to_ladder_and_masks():
+    rng = np.random.default_rng(2)
+    recs = [(rng.normal(size=(t, 3)), np.float64(t % 2)) for t in
+            [3, 3, 5, 5, 9, 9]]
+    pipe = datapipe.from_records(recs).bucket_batch(2, ladder=[4, 8, 16])
+    lengths = set()
+    for ds in pipe:
+        f = np.asarray(ds.features)
+        m = np.asarray(ds.features_mask)
+        assert f.shape[1] in (4, 8, 16)
+        lengths.add(f.shape[1])
+        # mask marks real steps; padded region is zeroed
+        assert m.shape == f.shape[:2]
+        np.testing.assert_array_equal(f[m == 0], 0.0)
+        assert m.sum(axis=1).min() >= 1
+    assert lengths == {4, 8, 16}
+
+
+def test_map_workers_preserve_order():
+    x, y = _arrays(n=40)
+    seq = _batches(datapipe.from_arrays(x, y)
+                   .map(lambda r: (r[0] + 1.0, r[1])).batch(8))
+    par = _batches(datapipe.from_arrays(x, y)
+                   .map(lambda r: (r[0] + 1.0, r[1]), workers=3).batch(8))
+    _assert_batches_equal(seq, par)
+
+
+# ---------------------------------------------------------------------------
+# Observability: metrics families + data_wait spans, chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_pipeline_metrics_and_spans(tmp_path):
+    from deeplearning4j_tpu.observability.metrics import (MetricsRegistry,
+                                                          set_registry)
+    from deeplearning4j_tpu.observability.trace import Tracer, set_tracer
+    reg_prev = set_registry(MetricsRegistry())
+    tracer_prev = set_tracer(Tracer(enabled=True))
+    try:
+        from deeplearning4j_tpu.observability.metrics import get_registry
+        from deeplearning4j_tpu.observability.trace import get_tracer
+        x, y = _arrays(n=32)
+        pipe = (datapipe.from_arrays(x, y).shuffle(window=8, seed=0)
+                .batch(8).prefetch(2))
+        list(pipe)
+        text = get_registry().render_prometheus()
+        for metric in ("dl4j_datapipe_records_total",
+                       "dl4j_datapipe_batches_total",
+                       "dl4j_datapipe_stall_fraction",
+                       "dl4j_datapipe_queue_depth",
+                       "dl4j_datapipe_stage_records_total"):
+            assert metric in text, metric
+        assert 'pipeline="datapipe"' in text
+        snap = pipe.stats.snapshot()
+        assert snap["records_total"] == 32 and snap["batches_total"] == 4
+        names = {s.name for s in get_tracer().spans()}
+        assert "data_wait" in names
+        assert "pipe_prefetch_pull" in names
+        out = str(tmp_path / "trace.json")
+        get_tracer().export_chrome_trace(out)
+        events = json.load(open(out))
+        evnames = {e.get("name") for e in
+                   (events["traceEvents"] if isinstance(events, dict)
+                    else events)}
+        assert "data_wait" in evnames
+        pipe.close()
+        # collector detaches with the pipeline
+        assert "dl4j_datapipe_records_total" not in \
+            get_registry().render_prometheus()
+    finally:
+        set_registry(reg_prev)
+        set_tracer(tracer_prev)
+
+
+# ---------------------------------------------------------------------------
+# fit() integration: auto_epochs pipelines advance per epoch
+# ---------------------------------------------------------------------------
+
+def test_mln_fit_over_pipeline_uses_distinct_epoch_orders():
+    x, y = _arrays(n=24)
+    pipe = datapipe.from_arrays(x, y).shuffle(window=8, seed=2).batch(6)
+    net = _mln()
+    net.fit(pipe, epochs=3)
+    assert net.iteration == 12                  # 4 batches x 3 epochs
+    assert pipe.epoch == 3
+
+    # replaying manually through the same per-epoch orders reproduces it
+    ref = _mln()
+    replay = datapipe.from_arrays(x, y).shuffle(window=8, seed=2).batch(6)
+    for _ in range(3):
+        for ds in replay:
+            ref.fit_batch(ds)
+    _assert_params_equal(net, ref)
+
+
+# ---------------------------------------------------------------------------
+# The headline: supervisor resume over a shuffled STREAMING source is
+# bit-identical (chaos-style, mirrors scripts/chaos_pipeline.py)
+# ---------------------------------------------------------------------------
+
+def _chaos_pipe(csv, batch=4, seed=11):
+    return (datapipe.from_csv(csv, label_index=0, num_classes=3)
+            .shuffle(window=3 * batch, seed=seed)
+            .batch(batch, drop_last=True)
+            .prefetch(2))
+
+
+def _cfg(d, every=3):
+    return SupervisorConfig(checkpoint_dir=str(d),
+                            checkpoint_every_steps=every,
+                            backoff_initial_s=0.01, handle_sigterm=False)
+
+
+def test_chaos_resume_preempt_mid_epoch_bit_identical(tmp_path):
+    csv = str(tmp_path / "train.csv")
+    _write_csv(csv, n=32, f=5, c=3)
+    epochs, per_epoch = 2, 8
+
+    ref = _mln(seed=5)
+    res = TrainingSupervisor(ref, _cfg(tmp_path / "ref")).fit(
+        _chaos_pipe(csv), epochs=epochs)
+    assert res.status == "completed"
+    assert res.final_step == epochs * per_epoch
+
+    ckpt = tmp_path / "chaos"
+    inj = FaultInjector()
+    inj.preempt_at_step(per_epoch + 3)          # mid-epoch 1, mid-window
+    net = _mln(seed=5)
+    r1 = TrainingSupervisor(net, _cfg(ckpt), injector=inj).fit(
+        _chaos_pipe(csv), epochs=epochs)
+    assert r1.status == "preempted"
+
+    # relaunch: FRESH net + FRESH pipeline, resume entirely from disk
+    net2 = _mln(seed=5)
+    r2 = TrainingSupervisor(net2, _cfg(ckpt)).fit(
+        _chaos_pipe(csv), epochs=epochs)
+    assert r2.status == "completed" and r2.resumed_from is not None
+    assert r2.final_step == epochs * per_epoch
+    _assert_params_equal(ref, net2)
+
+
+def test_chaos_resume_crash_during_save_bit_identical(tmp_path):
+    csv = str(tmp_path / "train.csv")
+    _write_csv(csv, n=24, f=5, c=3, seed=3)
+    epochs = 2
+
+    ref = _mln(seed=8)
+    TrainingSupervisor(ref, _cfg(tmp_path / "ref")).fit(
+        _chaos_pipe(csv, seed=2), epochs=epochs)
+
+    ckpt = tmp_path / "chaos"
+    inj = FaultInjector()
+    inj.crash_during_save(1)                    # kill the 2nd save mid-write
+    net = _mln(seed=8)
+    sup = TrainingSupervisor(net, _cfg(ckpt), injector=inj)
+    with pytest.raises(InjectedCrash):
+        with inj.installed():
+            sup.fit(_chaos_pipe(csv, seed=2), epochs=epochs)
+
+    net2 = _mln(seed=8)
+    r = TrainingSupervisor(net2, _cfg(ckpt)).fit(
+        _chaos_pipe(csv, seed=2), epochs=epochs)
+    assert r.status == "completed"
+    _assert_params_equal(ref, net2)
+
+
+def test_checkpoint_meta_carries_datapipe_state(tmp_path):
+    csv = str(tmp_path / "train.csv")
+    _write_csv(csv, n=32, f=5, c=3)
+    net = _mln(seed=5)
+    res = TrainingSupervisor(net, _cfg(tmp_path / "ck")).fit(
+        _chaos_pipe(csv), epochs=1)
+    assert res.status == "completed"
+    dirs = sorted(d for d in os.listdir(tmp_path / "ck")
+                  if d.startswith("step_"))
+    meta = read_checkpoint_meta(str(tmp_path / "ck" / dirs[-1]))
+    state = meta["datapipe"]
+    assert state["version"] == 1
+    assert state["stage"]["kind"] == "prefetch"
+
+
+def test_supervisor_detaches_pipeline_collector_on_exit(tmp_path):
+    from deeplearning4j_tpu.observability.metrics import (MetricsRegistry,
+                                                          set_registry)
+    csv = str(tmp_path / "train.csv")
+    _write_csv(csv, n=16, f=5, c=3)
+    prev = set_registry(MetricsRegistry())
+    try:
+        from deeplearning4j_tpu.observability.metrics import get_registry
+        res = TrainingSupervisor(_mln(), _cfg(tmp_path / "ck")).fit(
+            _chaos_pipe(csv), epochs=1)
+        assert res.status == "completed"
+        # back-to-back runs over fresh pipeline objects must not
+        # accumulate stale collectors in the global registry
+        assert "dl4j_datapipe" not in get_registry().render_prometheus()
+    finally:
+        set_registry(prev)
+
+
+def test_save_checkpoint_rejects_reserved_extra_meta_keys(tmp_path):
+    net = _mln()
+    x, y = _arrays()
+    net.fit_batch(DataSet(x[:8], y[:8]))
+    with pytest.raises(ValueError, match="reserved"):
+        save_checkpoint(net, str(tmp_path / "step_1"),
+                        extra_meta={"iteration": 99})
+    save_checkpoint(net, str(tmp_path / "step_1"),
+                    extra_meta={"datapipe": {"epoch": 0}})
+    assert read_checkpoint_meta(
+        str(tmp_path / "step_1"))["datapipe"] == {"epoch": 0}
+
+
+def test_prefetch_threads_stop_after_close():
+    x, y = _arrays(n=16)
+    pipe = datapipe.from_arrays(x, y).batch(4).prefetch(2)
+    it = iter(pipe)
+    next(it)
+    pipe.close()
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("dl4j-pipe-")]
+    assert alive == []
+
+
+# ---------------------------------------------------------------------------
+# Satellites: datasets/iterator.py contract fixes
+# ---------------------------------------------------------------------------
+
+def test_array_iterator_reset_restores_epoch0_order():
+    x, y = _arrays(n=20)
+    it = ArrayDataSetIterator(x, y, batch_size=5, shuffle=True, seed=4)
+    e0 = [np.asarray(ds.features) for ds in it]
+    e1 = [np.asarray(ds.features) for ds in it]
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))
+    it.reset()                                  # was a silent no-op before
+    r0 = [np.asarray(ds.features) for ds in it]
+    for a, b in zip(e0, r0):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_multiple_epochs_iterator_resets_base_and_count():
+    x, y = _arrays(n=12)
+    base = ArrayDataSetIterator(x, y, batch_size=4, shuffle=True, seed=1)
+    it = MultipleEpochsIterator(2, base)
+    run1 = [np.asarray(ds.features) for ds in it]
+    assert len(run1) == 6
+    # epochs inside one run see distinct orders (no reset between them)
+    assert not all(np.array_equal(a, b)
+                   for a, b in zip(run1[:3], run1[3:]))
+    assert list(it) == []                       # exhausted until reset
+    it.reset()
+    run2 = [np.asarray(ds.features) for ds in it]
+    for a, b in zip(run1, run2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_iterator_is_a_context_manager():
+    native_io = pytest.importorskip(
+        "deeplearning4j_tpu.datasets.native_io")
+    if not native_io.available():
+        pytest.skip("native loader unavailable")
+    from deeplearning4j_tpu.datasets.iterator import NativeDataSetIterator
+    x, y = _arrays(n=16)
+    with NativeDataSetIterator(x, y, batch_size=4, shuffle=False) as it:
+        assert len(list(it)) == 4
+
+
+def test_reconstruction_iterator_forwards_features_mask():
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=(2, 6, 3))
+    mask = np.array([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 0]], dtype=np.float64)
+    base = ListDataSetIterator([DataSet(f, None, mask, None)])
+    (out,) = list(ReconstructionDataSetIterator(base))
+    np.testing.assert_array_equal(out.labels, f)
+    np.testing.assert_array_equal(out.features_mask, mask)
+    np.testing.assert_array_equal(out.labels_mask, mask)
