@@ -365,11 +365,60 @@ def run_config(name: str) -> dict:
             "compile_count": rep["metrics"]["compile_count"],
             "model": rep["model"],
         }
+    if name == "mixed_precision":
+        return bench_mixed_precision()
     raise ValueError(f"unknown bench config '{name}'")
 
 
+def bench_mixed_precision(batch: int = 256, serve_rows: int = 2048) -> dict:
+    """Mixed-precision round (PRECISION.md / PERF.md §10): the SAME model
+    (lenet) trained under the f32 policy vs the bf16 policy — identical
+    topology, batch, and data, so the steps/sec ratio isolates what the
+    dtype policy buys — plus the serving forward's rows/sec in each
+    precision (the coalesced-bucket shape the server runs). On XLA:CPU
+    bf16 is emulated and the ratio is expected near (or below) 1.0; on
+    TPU/GPU backends the same entry reports the real half-width win."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import zoo
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+    xs = jnp.asarray(rng.normal(size=(serve_rows, 28, 28, 1)), jnp.float32)
+
+    out = {"model": "lenet", "batch": batch}
+    for key, policy in (("f32", zoo.F32), ("bf16", zoo.BF16)):
+        net = zoo.lenet(dtype=policy)
+        net.init(seed=42)
+        sec_per_step, n = calibrated_step_time(net, ds, scan0=50)
+        out[f"{key}_step_ms"] = round(1000.0 * sec_per_step, 3)
+        out[f"{key}_examples_per_sec"] = round(batch / sec_per_step, 1)
+        out[f"{key}_timing_window_steps"] = n
+        # serving forward: one warm-up compile, then min-of-3 timed runs
+        net.output(xs).block_until_ready()
+        best = min(_timed(lambda: net.output(xs).block_until_ready())
+                   for _ in range(3))
+        out[f"{key}_serving_rows_per_sec"] = round(serve_rows / best, 1)
+    out["train_speedup_bf16"] = round(
+        out["f32_step_ms"] / out["bf16_step_ms"], 3)
+    out["serving_speedup_bf16"] = round(
+        out["bf16_serving_rows_per_sec"] / out["f32_serving_rows_per_sec"],
+        3)
+    return out
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
-            "serving", "host_loop", "trace_overhead", "input_pipeline")
+            "serving", "host_loop", "trace_overhead", "input_pipeline",
+            "mixed_precision")
 
 
 def main():

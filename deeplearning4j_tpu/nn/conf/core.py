@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
@@ -32,17 +33,132 @@ from deeplearning4j_tpu.nn.updater import (
 )
 
 
+#: dtype strings a policy may name. Anything else (typos like "f32",
+#: unsupported widths like "float8") is rejected eagerly at config-build
+#: time — a policy typo must fail the builder, never silently train f32.
+VALID_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+def _validate_dtype(value, role: str) -> str:
+    if value not in VALID_DTYPES:
+        raise ValueError(
+            f"DtypePolicy: unknown {role} {value!r}; expected one of "
+            f"{list(VALID_DTYPES)}")
+    return value
+
+
 @dataclass(frozen=True)
 class DtypePolicy:
-    """Parameter/compute dtype policy. Matmuls and convs run in
-    ``compute_dtype`` (bf16 feeds the MXU at full rate); params, optimizer
-    state, and loss accumulate in ``param_dtype``."""
+    """Parameter/compute dtype policy (PRECISION.md). Matmuls and convs run
+    in ``compute_dtype`` (bf16 feeds the MXU at full rate); params,
+    optimizer state, LR schedules and loss reductions accumulate in
+    ``param_dtype`` (the f32 master copy of the mixed-precision recipe).
+
+    ``overrides`` keeps named sub-paths out of the global compute dtype:
+    a tuple of ``(regex, dtype)`` pairs matched against the layer name
+    with ``re.search`` (same per-path rule style as ``tp_rules``), first
+    match wins — e.g. ``((".*_bn$", "float32"),)`` pins every batch-norm
+    layer's compute to f32 under a bf16 policy.
+
+    Loss scaling (Micikevicius et al.; needed for f16, whose 5-bit
+    exponent underflows small gradients): ``loss_scale`` is ``"auto"``
+    (dynamic scaling iff ``compute_dtype == "float16"``), ``"dynamic"``,
+    ``"none"``, or a number (static scale). Dynamic scaling multiplies
+    the loss by the current scale, unscales gradients in ``param_dtype``,
+    SKIPS the update on any non-finite gradient while multiplying the
+    scale by ``1/loss_scale_factor``, and regrows it by
+    ``loss_scale_factor`` after ``loss_scale_growth_interval``
+    consecutive finite steps, starting from ``loss_scale_init``.
+
+    All fields are JSON-safe and round-trip through
+    ``MultiLayerConfiguration.to_json``; validation happens HERE, at
+    construction, so a bad policy fails the config builder with a clear
+    error instead of surfacing as an XLA dtype mismatch mid-fit."""
 
     param_dtype: str = "float32"
     compute_dtype: str = "float32"
+    #: per-path compute-dtype overrides: ((regex, dtype), ...)
+    overrides: Tuple[Tuple[str, str], ...] = ()
+    #: "auto" | "dynamic" | "none" | number (static scale)
+    loss_scale: Any = "auto"
+    loss_scale_init: float = 2.0 ** 15
+    loss_scale_factor: float = 2.0
+    loss_scale_growth_interval: int = 200
+
+    def __post_init__(self):
+        _validate_dtype(self.param_dtype, "param_dtype")
+        _validate_dtype(self.compute_dtype, "compute_dtype")
+        norm = []
+        for entry in self.overrides:
+            if len(entry) != 2:
+                raise ValueError(
+                    "DtypePolicy.overrides entries must be (regex, dtype) "
+                    f"pairs, got {entry!r}")
+            pattern, dtype = entry
+            try:
+                re.compile(pattern)
+            except re.error as e:
+                raise ValueError(
+                    f"DtypePolicy.overrides: bad regex {pattern!r}: {e}"
+                ) from None
+            _validate_dtype(dtype, f"override dtype for {pattern!r}")
+            norm.append((str(pattern), str(dtype)))
+        # JSON round-trips tuples as lists; normalize back so the policy
+        # stays hashable (frozen dataclass in a frozen config)
+        object.__setattr__(self, "overrides", tuple(norm))
+        ls = self.loss_scale
+        if isinstance(ls, str):
+            if ls not in ("auto", "dynamic", "none"):
+                raise ValueError(
+                    f"DtypePolicy: unknown loss_scale {ls!r}; expected "
+                    "'auto', 'dynamic', 'none', or a number")
+        elif not isinstance(ls, (int, float)) or ls <= 0:
+            raise ValueError(
+                f"DtypePolicy: loss_scale must be > 0, got {ls!r}")
+        if self.loss_scale_init <= 0:
+            raise ValueError("DtypePolicy: loss_scale_init must be > 0, "
+                             f"got {self.loss_scale_init!r}")
+        if self.loss_scale_factor <= 1.0:
+            raise ValueError("DtypePolicy: loss_scale_factor must be > 1, "
+                             f"got {self.loss_scale_factor!r}")
+        if self.loss_scale_growth_interval < 1:
+            raise ValueError(
+                "DtypePolicy: loss_scale_growth_interval must be >= 1, "
+                f"got {self.loss_scale_growth_interval!r}")
+
+    def compute_dtype_for(self, path: Optional[str]) -> str:
+        """Effective compute dtype for a named layer/path: the first
+        ``overrides`` rule whose regex ``re.search``-matches ``path``
+        wins; otherwise the global ``compute_dtype``."""
+        if path is not None:
+            for pattern, dtype in self.overrides:
+                if re.search(pattern, path):
+                    return dtype
+        return self.compute_dtype
+
+    def loss_scale_mode(self):
+        """Resolved scaling mode: None (off), "dynamic", or a static
+        float. "auto" resolves to dynamic exactly for f16 compute — bf16
+        keeps the f32 exponent range and needs no scaling."""
+        ls = self.loss_scale
+        if ls == "auto":
+            return "dynamic" if self.compute_dtype == "float16" else None
+        if ls == "none":
+            return None
+        if ls == "dynamic":
+            return "dynamic"
+        return float(ls)
 
     def to_dict(self):
         return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "DtypePolicy":
+        d = dict(d)
+        if "overrides" in d and d["overrides"] is not None:
+            d["overrides"] = tuple(tuple(e) for e in d["overrides"])
+        names = {f.name for f in dataclasses.fields(DtypePolicy)}
+        return DtypePolicy(**{k: v for k, v in d.items() if k in names})
 
 
 @dataclass(frozen=True)
@@ -90,7 +206,7 @@ class NeuralNetConfiguration:
         if isinstance(d.get("lr_schedule"), dict):
             d["lr_schedule"] = schedule_from_dict(d["lr_schedule"])
         if isinstance(d.get("dtype"), dict):
-            d["dtype"] = DtypePolicy(**d["dtype"])
+            d["dtype"] = DtypePolicy.from_dict(d["dtype"])
         names = {f.name for f in dataclasses.fields(NeuralNetConfiguration)}
         return NeuralNetConfiguration(**{k: v for k, v in d.items() if k in names})
 

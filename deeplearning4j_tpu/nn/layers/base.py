@@ -41,7 +41,9 @@ class Layer:
 
     @property
     def compute_dtype(self):
-        return jnp.dtype(self.policy.compute_dtype)
+        # Resolved per layer path so policy overrides like
+        # (("batchnorm", "float32"),) pin named layers (PRECISION.md).
+        return jnp.dtype(self.policy.compute_dtype_for(self.name))
 
     @property
     def activation_fn(self):

@@ -60,6 +60,15 @@ class OutputLayer(DenseLayer):
     def loss_fn(self) -> losses_mod.Loss:
         return losses_mod.get(self.conf.loss)
 
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # Inference mirrors the loss path's precision: the head's
+        # activation (softmax et al.) runs in param dtype even when the
+        # matmul ran in bf16, so serving outputs are full-precision
+        # probabilities under any policy.
+        x = self._input_dropout(x, train, rng)
+        z = self.preout(params, x).astype(self.param_dtype)
+        return self.activation_fn(z), state
+
     def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
         x = self._input_dropout(x, train, rng)
         # loss math (softmax/log) in param dtype (f32) for stability

@@ -159,8 +159,12 @@ class RnnOutputLayerImpl(Layer):
         return z
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # Head activation in param dtype, mirroring the loss path, so
+        # per-timestep serving outputs are full precision under any
+        # policy (see OutputLayer.apply).
         x = self._input_dropout(x, train, rng)
-        return self.activation_fn(self.preout(params, x)), state
+        z = self.preout(params, x).astype(self.param_dtype)
+        return self.activation_fn(z), state
 
     def loss(self, params, x, labels, *, train=False, rng=None, mask=None):
         x = self._input_dropout(x, train, rng)
@@ -176,6 +180,12 @@ class RnnOutputLayerImpl(Layer):
 class TimeDistributedDenseLayer(RnnOutputLayerImpl):
     """Per-timestep dense, no loss head (Keras TimeDistributed(Dense) /
     the reference's KerasLayer.java:206-212 mapping)."""
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # Mid-network layer: unlike the RnnOutput head, its activation
+        # stays in compute dtype between layers.
+        x = self._input_dropout(x, train, rng)
+        return self.activation_fn(self.preout(params, x)), state
 
     def loss(self, *args, **kwargs):
         raise ValueError(

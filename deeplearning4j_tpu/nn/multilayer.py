@@ -40,6 +40,7 @@ from deeplearning4j_tpu.nn.conf.preprocessors import (
     FeedForwardToCnn,
     RnnToFeedForward,
 )
+from deeplearning4j_tpu.nn import precision
 from deeplearning4j_tpu.nn.updater import apply_layer_updates
 
 
@@ -139,6 +140,9 @@ class MultiLayerNetwork:
                 if layer.name in params:
                     upd = layer.resolve("updater")
                     opt_state[layer.name] = upd.init_state(params[layer.name])
+            ls = precision.init_loss_scale_state(gc.dtype)
+            if ls is not None:
+                opt_state[precision.LOSS_SCALE_KEY] = ls
             return params, state, opt_state
 
         if structure_only:
@@ -171,6 +175,9 @@ class MultiLayerNetwork:
             if layer.name in self.params:
                 upd = layer.resolve("updater")
                 opt_state[layer.name] = upd.init_state(self.params[layer.name])
+        ls = precision.init_loss_scale_state(self.conf.global_conf.dtype)
+        if ls is not None:
+            opt_state[precision.LOSS_SCALE_KEY] = ls
         self.opt_state = opt_state
 
     def set_lr_scale(self, scale: float):
@@ -368,24 +375,17 @@ class MultiLayerNetwork:
         return self.remat_prefixes
 
     def _step_fn(self):
-        """The raw (un-jitted) fused train step: fwd+bwd+normalize+update."""
+        """The raw (un-jitted) fused train step: fwd+bwd+normalize+update,
+        with loss scaling when the dtype policy calls for it (f16) —
+        see nn/precision.py."""
         self._resolve_remat()
         gc = self.conf.global_conf
-        layers = self.layers
-        lr_scale = self._lr_scale
 
         def loss_fn(params, state, x, labels, fmask, lmask, rng):
             return self._loss(params, state, x, labels, fmask, lmask, rng)
 
-        def step_fn(params, state, opt_state, it, x, labels, fmask, lmask, rng):
-            (score, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, state, x, labels, fmask, lmask,
-                                       rng)
-            new_params, new_opt = apply_layer_updates(
-                layers, gc, params, grads, opt_state, it, lr_scale)
-            return new_params, new_state, new_opt, score
-
-        return step_fn
+        return precision.build_step_fn(loss_fn, self.layers, gc,
+                                       self._lr_scale)
 
     def _build_train_step(self):
         step_fn = self._step_fn()

@@ -28,6 +28,15 @@ import jax.numpy as jnp
 _UPDATERS: dict[str, type] = {}
 
 
+def _lr_dtype(lr):
+    """The dtype lr arithmetic should run in: the schedule output's own
+    dtype (the policy's master dtype once apply_layer_updates routed it
+    through), falling back to f32 for plain-float callers. Keeps bias
+    corrections and scheduled rates pinned to the master dtype instead
+    of drifting with `jax_enable_x64` weak-type promotion."""
+    return lr.dtype if hasattr(lr, "dtype") else jnp.float32
+
+
 def register_updater(cls):
     _UPDATERS[cls.kind] = cls
     return cls
@@ -127,7 +136,7 @@ class Adam(Updater):
             lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
         v = jax.tree_util.tree_map(
             lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
-        tf = t.astype(jnp.float32)
+        tf = t.astype(_lr_dtype(lr))
         alpha = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
         deltas = jax.tree_util.tree_map(
             lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + self.epsilon), m, v)
@@ -157,7 +166,7 @@ class AdaMax(Updater):
             lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
         u = jax.tree_util.tree_map(
             lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g)), state["u"], grads)
-        tf = t.astype(jnp.float32)
+        tf = t.astype(_lr_dtype(lr))
         alpha = lr / (1 - b1 ** tf)
         deltas = jax.tree_util.tree_map(
             lambda m_, u_: alpha * m_ / (u_ + self.epsilon), m, u)
@@ -266,6 +275,12 @@ def schedule_from_dict(d):
 
 @dataclass(frozen=True)
 class Schedule:
+    """Schedule math runs entirely in ``dtype`` — the policy's master
+    dtype when called from ``apply_layer_updates``, f32 for callers that
+    don't pass one. This pins the scheduled rate regardless of the
+    compute dtype and of `jax_enable_x64` (a bare Python float would
+    weak-type-promote under x64)."""
+
     kind = "base"
 
     def to_dict(self):
@@ -273,7 +288,7 @@ class Schedule:
         d["kind"] = self.kind
         return d
 
-    def __call__(self, base_lr, step):
+    def __call__(self, base_lr, step, dtype=None):
         raise NotImplementedError
 
 
@@ -282,8 +297,8 @@ class Schedule:
 class NoneSchedule(Schedule):
     kind = "none"
 
-    def __call__(self, base_lr, step):
-        return jnp.asarray(base_lr, jnp.float32)
+    def __call__(self, base_lr, step, dtype=None):
+        return jnp.asarray(base_lr, dtype or jnp.float32)
 
 
 @register_schedule
@@ -292,8 +307,10 @@ class Exponential(Schedule):
     kind = "exponential"
     decay_rate: float = 0.99
 
-    def __call__(self, base_lr, step):
-        return base_lr * self.decay_rate ** step.astype(jnp.float32)
+    def __call__(self, base_lr, step, dtype=None):
+        dtype = dtype or jnp.float32
+        return jnp.asarray(base_lr, dtype) * jnp.asarray(
+            self.decay_rate, dtype) ** step.astype(dtype)
 
 
 @register_schedule
@@ -303,8 +320,10 @@ class Inverse(Schedule):
     gamma: float = 1e-3
     power: float = 1.0
 
-    def __call__(self, base_lr, step):
-        return base_lr / (1.0 + self.gamma * step.astype(jnp.float32)) ** self.power
+    def __call__(self, base_lr, step, dtype=None):
+        dtype = dtype or jnp.float32
+        return jnp.asarray(base_lr, dtype) / (
+            1.0 + self.gamma * step.astype(dtype)) ** self.power
 
 
 @register_schedule
@@ -314,9 +333,10 @@ class Poly(Schedule):
     power: float = 1.0
     max_iter: int = 10000
 
-    def __call__(self, base_lr, step):
-        frac = jnp.clip(step.astype(jnp.float32) / self.max_iter, 0.0, 1.0)
-        return base_lr * (1.0 - frac) ** self.power
+    def __call__(self, base_lr, step, dtype=None):
+        dtype = dtype or jnp.float32
+        frac = jnp.clip(step.astype(dtype) / self.max_iter, 0.0, 1.0)
+        return jnp.asarray(base_lr, dtype) * (1.0 - frac) ** self.power
 
 
 @register_schedule
@@ -326,9 +346,10 @@ class Sigmoid(Schedule):
     gamma: float = 1e-2
     steps: int = 1000
 
-    def __call__(self, base_lr, step):
-        return base_lr / (
-            1.0 + jnp.exp(self.gamma * (step.astype(jnp.float32) - self.steps)))
+    def __call__(self, base_lr, step, dtype=None):
+        dtype = dtype or jnp.float32
+        return jnp.asarray(base_lr, dtype) / (
+            1.0 + jnp.exp(self.gamma * (step.astype(dtype) - self.steps)))
 
 
 @register_schedule
@@ -338,9 +359,10 @@ class Step(Schedule):
     decay_rate: float = 0.1
     steps: int = 1000
 
-    def __call__(self, base_lr, step):
-        return base_lr * self.decay_rate ** jnp.floor(
-            step.astype(jnp.float32) / self.steps)
+    def __call__(self, base_lr, step, dtype=None):
+        dtype = dtype or jnp.float32
+        return jnp.asarray(base_lr, dtype) * jnp.asarray(
+            self.decay_rate, dtype) ** jnp.floor(step.astype(dtype) / self.steps)
 
 
 @register_schedule
@@ -352,10 +374,11 @@ class MapSchedule(Schedule):
     kind = "map"
     schedule: dict = field(default_factory=dict)
 
-    def __call__(self, base_lr, step):
-        lr = jnp.asarray(base_lr, jnp.float32)
+    def __call__(self, base_lr, step, dtype=None):
+        dtype = dtype or jnp.float32
+        lr = jnp.asarray(base_lr, dtype)
         for it in sorted(self.schedule):
-            lr = jnp.where(step >= it, jnp.float32(self.schedule[it]), lr)
+            lr = jnp.where(step >= it, jnp.asarray(self.schedule[it], dtype), lr)
         return lr
 
 
@@ -410,14 +433,26 @@ def apply_layer_updates(layers, gc, params, grads, opt_state, it,
     compile-time constant of the step; nets invalidate their cached step
     when it changes).
 
+    Mixed-precision contract (PRECISION.md): the update runs entirely in
+    the policy's master dtype. Gradients arriving in a lower compute
+    dtype are upcast to each parameter's own dtype before normalization
+    and the updater rule, so optimizer slots (init'd as zeros_like the
+    f32 masters) never see low-precision arithmetic; the scheduled lr is
+    computed in the master dtype (never the compute dtype, never x64).
+
+    Non-layer keys in ``opt_state`` (e.g. precision's ``_loss_scale``)
+    pass through untouched.
+
     Returns (new_params, new_opt_state)."""
+    master = jnp.dtype(gc.dtype.param_dtype)
     new_params = dict(params)
     new_opt = dict(opt_state)
     for layer in layers:
         name = layer.name
         if name not in params:
             continue
-        g = grads[name]
+        g = jax.tree_util.tree_map(
+            lambda gr, p: gr.astype(p.dtype), grads[name], params[name])
         mode = layer.resolve("gradient_normalization")
         thr = float(layer.resolve("gradient_normalization_threshold", 1.0)
                     or 1.0)
@@ -428,7 +463,7 @@ def apply_layer_updates(layers, gc, params, grads, opt_state, it,
             base_lr = gc.learning_rate
         if base_lr is None:
             base_lr = upd.learning_rate
-        lr = gc.lr_schedule(base_lr, it) * lr_scale
+        lr = gc.lr_schedule(base_lr, it, dtype=master) * lr_scale
         deltas, new_opt[name] = upd.update(g, opt_state[name], lr)
         new_params[name] = jax.tree_util.tree_map(
             lambda p, d: p - d, params[name], deltas)

@@ -29,6 +29,16 @@ Mechanics:
 - Drain: ``stop()`` flushes every pending ticket through the device
   before the thread exits — no request accepted before shutdown is
   dropped.
+
+Precision contracts (PRECISION.md): under the default f32 serving path
+every coalesced row is BIT-IDENTICAL to the same row served alone
+(min_batch=2 floor + padded buckets guarantee it). When the server is
+built with ``compute_dtype="bfloat16"``, matmul compute runs half-width
+through a shadow policy view of the same f32 params — rows then carry a
+numeric-TOLERANCE contract (~1e-2 relative vs the f32 forward; heads
+still activate in f32), not bit-identity. The batcher itself is
+dtype-agnostic: both contracts are properties of the forward_fn it is
+given.
 """
 
 from __future__ import annotations

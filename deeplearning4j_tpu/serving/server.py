@@ -66,7 +66,8 @@ class ModelServer:
     def __init__(self, net, host: str = "127.0.0.1", port: int = 9500,
                  max_batch: int = 1024, batch_window_ms: float = 2.0,
                  max_queue: int = 1024, warmup: bool = True,
-                 input_shapes=None, request_timeout_s: float = 300.0):
+                 input_shapes=None, request_timeout_s: float = 300.0,
+                 compute_dtype=None):
         self.net = net
         self.host = host
         self.port = port
@@ -78,6 +79,18 @@ class ModelServer:
         self._thread = None
         self._is_graph = hasattr(net, "conf") and hasattr(
             net.conf, "network_inputs")
+        # Serving precision contract (PRECISION.md / SERVING.md):
+        # compute_dtype=None serves with the net's own policy and keeps
+        # the bit-identity contract (coalesced rows == row-at-a-time
+        # rows, bit for bit). An explicit compute_dtype (e.g. "bfloat16")
+        # serves through a shadow view of the SAME params under a
+        # replaced policy — outputs then carry a numeric-tolerance
+        # contract vs the f32 forward, not bit-identity.
+        self.compute_dtype = compute_dtype
+        self._serving_net = None
+        if (compute_dtype is not None and compute_dtype
+                != net.conf.global_conf.dtype.compute_dtype):
+            self._serving_net = self._build_serving_net(compute_dtype)
         self.stats = ServingStats()
         self._batcher = MicroBatcher(
             self._device_forward, max_batch=max_batch,
@@ -89,11 +102,41 @@ class ModelServer:
         self.shapes_seen = self._batcher.shapes_seen
 
     # ------------------------------------------------------------ device side
+    def _build_serving_net(self, compute_dtype):
+        """A shadow net over the same configuration with only the
+        policy's compute dtype replaced: structure-only init (no second
+        parameter set is ever materialized — ``_device_forward`` aliases
+        the primary net's live params/state each call, so a net that is
+        still training serves its freshest weights)."""
+        import dataclasses as _dc
+        gc = self.net.conf.global_conf
+        # dataclasses.replace re-runs DtypePolicy validation, so an
+        # unknown dtype string fails here, at server build time
+        gc2 = _dc.replace(gc, dtype=_dc.replace(
+            gc.dtype, compute_dtype=compute_dtype))
+        conf2 = _dc.replace(self.net.conf, global_conf=gc2)
+        shadow = type(self.net)(conf2)
+        shadow.init(structure_only=True)
+        return shadow
+
+    @property
+    def serving_compute_dtype(self) -> str:
+        """The dtype the serving forward actually computes in (the
+        ``compute_dtype`` label on serving metrics)."""
+        if self.compute_dtype is not None:
+            return self.compute_dtype
+        return self.net.conf.global_conf.dtype.compute_dtype
+
     def _device_forward(self, feats):
         """Model adapter run only on the batcher's device thread."""
+        net = self.net
+        if self._serving_net is not None:
+            self._serving_net.params = self.net.params
+            self._serving_net.state = self.net.state
+            net = self._serving_net
         if self._is_graph:
-            return self.net.output(*feats)
-        return self.net.output(feats[0])
+            return net.output(*feats)
+        return net.output(feats[0])
 
     def _infer_row_shapes(self):
         """Per-input row shapes (no batch dim) for warm-up, when they can
@@ -299,7 +342,8 @@ class ModelServer:
         self.port = self._httpd.server_address[1]
         _obs_metrics.install_runtime_metrics()
         self.stats.attach_to_registry(
-            labels={"server": f"{self.host}:{self.port}"},
+            labels={"server": f"{self.host}:{self.port}",
+                    "compute_dtype": self.serving_compute_dtype},
             shapes_fn=lambda: self.shapes_seen)
         import threading
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -329,9 +373,11 @@ class ModelServer:
 def serve(net, host: str = "127.0.0.1", port: int = 9500,
           max_batch: int = 1024, batch_window_ms: float = 2.0,
           max_queue: int = 1024, warmup: bool = True,
-          input_shapes=None, request_timeout_s: float = 300.0) -> ModelServer:
+          input_shapes=None, request_timeout_s: float = 300.0,
+          compute_dtype=None) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
                        warmup=warmup, input_shapes=input_shapes,
-                       request_timeout_s=request_timeout_s).start()
+                       request_timeout_s=request_timeout_s,
+                       compute_dtype=compute_dtype).start()

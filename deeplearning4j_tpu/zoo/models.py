@@ -35,6 +35,9 @@ from deeplearning4j_tpu.nn.updater import Adam, Nesterovs
 
 BF16 = DtypePolicy(param_dtype="float32", compute_dtype="bfloat16")
 F32 = DtypePolicy(param_dtype="float32", compute_dtype="float32")
+# f16 compute implies dynamic loss scaling (DtypePolicy loss_scale="auto"
+# resolves to dynamic for float16) — see PRECISION.md
+F16 = DtypePolicy(param_dtype="float32", compute_dtype="float16")
 
 
 def mnist_mlp(seed: int = 42, dtype: Optional[DtypePolicy] = None

@@ -20,14 +20,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 from bench import (_peak_flops, bench_host_loop, bench_input_pipeline,
-                   bench_trace_overhead, calibrated_step_time)
+                   bench_mixed_precision, bench_trace_overhead,
+                   calibrated_step_time)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=["resnet50", "lenet", "char_rnn",
                                        "mnist_mlp", "resnet18", "host_loop",
-                                       "trace_overhead", "input_pipeline"])
+                                       "trace_overhead", "input_pipeline",
+                                       "mixed_precision"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
@@ -76,6 +78,15 @@ def main():
         out = {"config": "input_pipeline"}
         out.update(bench_input_pipeline(
             batch=batch, n_batches=args.n_batches, epochs=args.epochs))
+        finish(out)
+        return
+
+    if args.config == "mixed_precision":
+        # the precision round: lenet trained + served under the f32 vs
+        # bf16 dtype policies — steps/sec and serving rows/sec ratios
+        # (bench.bench_mixed_precision; PRECISION.md, PERF.md §10)
+        out = {"config": "mixed_precision"}
+        out.update(bench_mixed_precision(batch=args.batch))
         finish(out)
         return
 

@@ -1,0 +1,458 @@
+"""Mixed-precision dtype-policy runtime tests (PRECISION.md).
+
+Covers: eager policy validation + JSON round-trip, per-path override
+rules, f32 master params / optimizer slots under bf16 compute, schedule
+math pinned to the master dtype under `jax_enable_x64` (the conftest
+enables x64 globally, so the hygiene lint here is meaningful), a
+precision-hygiene sweep over zoo models (no silent f64 upcasts, no bf16
+leaking into checkpointed masters), dynamic loss-scaling edge cases
+(overflow skip with bit-identical params, deterministic backoff /
+regrowth, composition with `resilient_fit`'s NaN sentinel), and the
+bf16 serving path's tolerance contract + `compute_dtype` metrics label.
+
+The convergence-parity runs live under the `slow` marker.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import (DtypePolicy,
+                                             MultiLayerConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.precision import (LOSS_SCALE_KEY,
+                                             current_loss_scale)
+from deeplearning4j_tpu.nn.updater import (Adam, Exponential, MapSchedule,
+                                           NoneSchedule, Sgd)
+from deeplearning4j_tpu.resilience import resilient_fit
+from deeplearning4j_tpu.serving.server import ModelServer, serve
+from deeplearning4j_tpu.utils.checkpoint import (
+    restore_multi_layer_network, save_checkpoint)
+from deeplearning4j_tpu.zoo import models as zoo
+
+BF16 = DtypePolicy(param_dtype="float32", compute_dtype="bfloat16")
+F16 = DtypePolicy(param_dtype="float32", compute_dtype="float16")
+
+
+def _mlp(policy=None, seed=3, lr=1e-2, updater=None):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater or Adam(lr)))
+    if policy is not None:
+        b = b.dtype(policy)
+    conf = (b.list()
+            .layer(Dense(n_in=5, n_out=7, activation="tanh"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _leaf_dtypes(tree):
+    return {str(l.dtype) for l in jax.tree_util.tree_leaves(tree)}
+
+
+def _force_scale(net, value):
+    """Overwrite the live loss-scale state (test lever for deterministic
+    overflow: a huge scale saturates the f16 cotangents to inf)."""
+    net.opt_state = {**net.opt_state, LOSS_SCALE_KEY: {
+        "scale": jnp.asarray(value, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32)}}
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: eager config-time validation
+# ---------------------------------------------------------------------------
+
+def test_unknown_dtype_strings_rejected_at_build_time():
+    with pytest.raises(ValueError, match="float8"):
+        DtypePolicy(compute_dtype="float8")
+    with pytest.raises(ValueError, match="int8"):
+        DtypePolicy(param_dtype="int8")
+    with pytest.raises(ValueError, match="half"):
+        DtypePolicy(overrides=(("dense", "half"),))
+
+
+def test_policy_validation_covers_overrides_and_scaling_knobs():
+    with pytest.raises(ValueError):  # regex must compile
+        DtypePolicy(overrides=(("(", "float32"),))
+    with pytest.raises(ValueError):  # 2-tuples only
+        DtypePolicy(overrides=(("dense",),))
+    with pytest.raises(ValueError):
+        DtypePolicy(loss_scale="sometimes")
+    with pytest.raises(ValueError):
+        DtypePolicy(loss_scale=-2.0)
+    with pytest.raises(ValueError):
+        DtypePolicy(loss_scale_init=0.0)
+    with pytest.raises(ValueError):
+        DtypePolicy(loss_scale_factor=1.0)
+    with pytest.raises(ValueError):
+        DtypePolicy(loss_scale_growth_interval=0)
+    # the valid spellings all construct
+    DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    DtypePolicy(compute_dtype="bfloat16",
+                overrides=(("batchnorm.*", "float32"),))
+    DtypePolicy(compute_dtype="float16", loss_scale=1024.0)
+
+
+def test_policy_json_roundtrip_preserves_overrides_and_knobs():
+    policy = DtypePolicy(
+        compute_dtype="float16",
+        overrides=(("layer_0", "float32"), (".*norm", "bfloat16")),
+        loss_scale="dynamic", loss_scale_init=2.0 ** 12,
+        loss_scale_factor=4.0, loss_scale_growth_interval=50)
+    conf = (NeuralNetConfiguration.builder().seed(1).dtype(policy)
+            .updater(Sgd(0.1)).list()
+            .layer(Dense(n_in=4, n_out=4))
+            .layer(Output(n_out=2, loss="mse"))
+            .build())
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.global_conf.dtype == policy
+
+
+# ---------------------------------------------------------------------------
+# Per-path override rules (tp_rules-style regex, first match wins)
+# ---------------------------------------------------------------------------
+
+def test_override_first_match_wins():
+    p = DtypePolicy(compute_dtype="bfloat16",
+                    overrides=(("dense", "float32"), (".*", "float16")))
+    assert p.compute_dtype_for("dense_3") == "float32"
+    assert p.compute_dtype_for("conv_1") == "float16"
+    assert p.compute_dtype_for(None) == "bfloat16"  # unnamed layers
+
+
+def test_override_pins_named_layer_compute_dtype():
+    policy = DtypePolicy(compute_dtype="bfloat16",
+                         overrides=(("layer_0", "float32"),))
+    net = _mlp(policy)
+    assert net.layers[0].compute_dtype == jnp.float32
+    assert net.layers[1].compute_dtype == jnp.dtype(jnp.bfloat16)
+    net.fit_batch(_data())  # and the step traces/executes fine
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: bf16 compute, f32 masters + slots
+# ---------------------------------------------------------------------------
+
+def test_bf16_policy_masters_and_slots_stay_f32():
+    net = _mlp(BF16)
+    assert net.layers[0].compute_dtype == jnp.dtype(jnp.bfloat16)
+    ds = _data()
+    for _ in range(3):
+        score = net.fit_batch(ds)
+    assert np.isfinite(float(score))
+    assert _leaf_dtypes(net.params) == {"float32"}
+    assert _leaf_dtypes(net.opt_state) <= {"float32", "int32"}
+    # hidden activations genuinely run half-width...
+    acts = net.feed_forward(jnp.asarray(_data().features))
+    assert acts[0].dtype == jnp.dtype(jnp.bfloat16)
+    # ...but the head activates in param dtype (serving outputs are f32)
+    assert acts[-1].dtype == jnp.float32
+    # bf16 policy needs no loss scaling
+    assert LOSS_SCALE_KEY not in net.opt_state
+
+
+def test_default_policy_unchanged_no_scale_state():
+    net = _mlp()  # no policy: f32/f32, must trace the seed step
+    net.fit_batch(_data())
+    assert LOSS_SCALE_KEY not in net.opt_state
+    assert _leaf_dtypes(net.params) == {"float32"}
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: schedule math routed through the master dtype (x64-proof)
+# ---------------------------------------------------------------------------
+
+def test_schedules_pinned_to_f32_by_default_under_x64():
+    # conftest enables jax_enable_x64 — without the explicit dtype pin,
+    # python-float schedule math would weak-type-promote to f64
+    step = jnp.asarray(7, jnp.int32)
+    for sched in (NoneSchedule(), Exponential(0.9),
+                  MapSchedule(schedule={5: 0.01})):
+        assert sched(0.1, step).dtype == jnp.float32
+
+
+def test_schedules_follow_master_dtype():
+    step = jnp.asarray(7, jnp.int32)
+    for sched in (NoneSchedule(), Exponential(0.9),
+                  MapSchedule(schedule={5: 0.01})):
+        assert sched(0.1, step, dtype=jnp.float64).dtype == jnp.float64
+        assert sched(0.1, step, dtype=jnp.float32).dtype == jnp.float32
+
+
+def test_f64_policy_trains_in_f64_end_to_end():
+    F64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    net = _mlp(F64, updater=Adam(1e-2))
+    ds = _data()
+    for _ in range(2):
+        net.fit_batch(ds)
+    assert _leaf_dtypes(net.params) == {"float64"}
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: precision-hygiene sweep (no silent f64, no bf16 leaks)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("build", [
+    lambda: zoo.mnist_mlp(dtype=zoo.F32),
+    lambda: zoo.mnist_mlp(dtype=zoo.BF16),
+    lambda: zoo.mnist_mlp(dtype=zoo.F16),
+    lambda: zoo.lenet(dtype=zoo.BF16),
+], ids=["mlp_f32", "mlp_bf16", "mlp_f16", "lenet_bf16"])
+def test_zoo_precision_hygiene(build):
+    net = build()
+    net.init(seed=7)
+    rng = np.random.default_rng(0)
+    shape = ((8, 784) if net.conf.layers[0].layer_type == "dense"
+             else (8, 28, 28, 1))
+    x = rng.normal(size=shape).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    net.fit_batch(DataSet(x, y))
+    # x64 is ON in this suite: any weak-type slip would surface as f64
+    assert "float64" not in _leaf_dtypes(net.params)
+    assert "float64" not in _leaf_dtypes(net.opt_state)
+    out = net.output(x)
+    assert out.dtype == jnp.float32  # serving output: not f64, not bf16
+    # master params are f32 under every policy in the sweep
+    assert _leaf_dtypes(net.params) == {"float32"}
+
+
+def test_checkpointed_masters_never_bf16(tmp_path):
+    net = zoo.mnist_mlp(dtype=zoo.BF16)
+    net.init(seed=7)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    net.fit_batch(DataSet(x, y))
+    save_checkpoint(net, str(tmp_path / "ck"))
+    restored = restore_multi_layer_network(str(tmp_path / "ck"))
+    assert _leaf_dtypes(restored.params) == {"float32"}
+    assert "bfloat16" not in _leaf_dtypes(restored.opt_state)
+
+
+# ---------------------------------------------------------------------------
+# f16 dynamic loss scaling
+# ---------------------------------------------------------------------------
+
+def test_f16_policy_creates_scale_state_and_checkpoints_it(tmp_path):
+    net = _mlp(F16)
+    assert LOSS_SCALE_KEY in net.opt_state
+    assert current_loss_scale(net) == 2.0 ** 15  # default init
+    ds = _data()
+    for _ in range(3):
+        net.fit_batch(ds)
+    save_checkpoint(net, str(tmp_path / "ck"))
+    restored = restore_multi_layer_network(str(tmp_path / "ck"))
+    assert current_loss_scale(restored) == current_loss_scale(net)
+    # lockstep continuation stays bit-identical (scale state included)
+    for _ in range(2):
+        net.fit_batch(ds)
+        restored.fit_batch(ds)
+    for name, sub in net.params.items():
+        for k, arr in sub.items():
+            np.testing.assert_array_equal(
+                np.asarray(arr), np.asarray(restored.params[name][k]))
+
+
+def test_overflow_step_skipped_params_bit_identical():
+    net = _mlp(F16)
+    ds = _data()
+    net.fit_batch(ds)  # warm/compile with a sane scale
+    _force_scale(net, 2.0 ** 30)  # saturates f16 cotangents -> inf grads
+    before = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(),
+                                    net.params)
+    before_opt = jax.tree_util.tree_map(
+        lambda a: np.asarray(a).copy(),
+        {k: v for k, v in net.opt_state.items() if k != LOSS_SCALE_KEY})
+    score = net.fit_batch(ds)
+    # the reported score is the TRUE (unscaled) loss — finite, so the
+    # resilience NaN sentinel sees nothing to roll back
+    assert np.isfinite(float(score))
+    after = jax.tree_util.tree_map(np.asarray, net.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+    after_opt = jax.tree_util.tree_map(
+        np.asarray,
+        {k: v for k, v in net.opt_state.items() if k != LOSS_SCALE_KEY})
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before_opt,
+                           after_opt)
+    # and the scale backed off
+    assert current_loss_scale(net) == 2.0 ** 29
+    assert int(net.opt_state[LOSS_SCALE_KEY]["good_steps"]) == 0
+
+
+def test_backoff_and_regrowth_sequence_deterministic():
+    policy = DtypePolicy(compute_dtype="float16",
+                         loss_scale_init=2.0 ** 10,
+                         loss_scale_growth_interval=2)
+    net = _mlp(policy, updater=Sgd(1e-3))
+    ds = _data()
+    seen = []
+    for _ in range(4):
+        net.fit_batch(ds)
+        seen.append(current_loss_scale(net))
+    # grow by 2x after every 2 consecutive finite steps
+    assert seen == [2.0 ** 10, 2.0 ** 11, 2.0 ** 11, 2.0 ** 12]
+    _force_scale(net, 2.0 ** 30)
+    net.fit_batch(ds)
+    assert current_loss_scale(net) == 2.0 ** 29  # deterministic backoff
+
+
+def test_static_loss_scale_pins_scale_but_still_skips():
+    policy = DtypePolicy(compute_dtype="float16", loss_scale=1024.0)
+    net = _mlp(policy, updater=Sgd(1e-3))
+    ds = _data()
+    for _ in range(3):
+        net.fit_batch(ds)
+    assert current_loss_scale(net) == 1024.0  # never moves
+    _force_scale(net, 1024.0)
+    before = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(),
+                                    net.params)
+    _force_scale(net, 2.0 ** 30)
+    net.fit_batch(ds)
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, before,
+        jax.tree_util.tree_map(np.asarray, net.params))
+
+
+def test_multi_batch_scan_carries_scale_state():
+    # nn/multistep.py's lax.scan carries opt_state wholesale — k fused
+    # steps must track k separate fit_batch calls, loss-scale state
+    # included and bit-identical. (Params are compared at 1-ulp
+    # tolerance: XLA reassociates the scaled step's unscale-multiply
+    # differently inside a scan body on CPU; the default unscaled path
+    # keeps the strict bit-identity pin in test_async_runtime.py.)
+    a = _mlp(F16, seed=11)
+    b = _mlp(F16, seed=11)
+    ds = _data()
+    for _ in range(4):
+        a.fit_batch(ds)
+    b.fit_batch_repeated(ds, 4)
+    assert current_loss_scale(a) == current_loss_scale(b)
+    assert (int(a.opt_state[LOSS_SCALE_KEY]["good_steps"])
+            == int(b.opt_state[LOSS_SCALE_KEY]["good_steps"]))
+    for name, sub in a.params.items():
+        for k, arr in sub.items():
+            np.testing.assert_allclose(
+                np.asarray(arr), np.asarray(b.params[name][k]),
+                rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 4: composition with the resilience NaN sentinel
+# ---------------------------------------------------------------------------
+
+def test_resilient_fit_composes_with_skipped_scale_steps(tmp_path):
+    # an absurd initial scale forces overflow-skip steps at the start;
+    # the supervisor must NOT see them as divergence (no rollback), and
+    # the scale must back off until training proceeds
+    policy = DtypePolicy(compute_dtype="float16",
+                         loss_scale_init=2.0 ** 24)
+    net = _mlp(policy, updater=Sgd(1e-3))
+    res = resilient_fit(net, _data(), checkpoint_dir=str(tmp_path),
+                        epochs=8, checkpoint_every_steps=3)
+    assert res.status == "completed"
+    assert res.stats["rollbacks_total"] == 0  # no double-firing
+    assert current_loss_scale(net) < 2.0 ** 24  # backoff happened
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+# ---------------------------------------------------------------------------
+# Serving: bf16 tolerance contract + compute_dtype label
+# ---------------------------------------------------------------------------
+
+def test_serving_default_path_bit_identical():
+    net = zoo.mnist_mlp(dtype=zoo.F32)
+    net.init(seed=5)
+    x = np.random.default_rng(1).normal(size=(6, 784)).astype(np.float32)
+    server = ModelServer(net, warmup=False)
+    try:
+        out = server.predict(x)
+        np.testing.assert_array_equal(out, np.asarray(net.output(x)))
+        assert server.serving_compute_dtype == "float32"
+    finally:
+        server.stop()
+
+
+def test_serving_bf16_tolerance_contract():
+    net = zoo.mnist_mlp(dtype=zoo.F32)
+    net.init(seed=5)
+    x = np.random.default_rng(1).normal(size=(6, 784)).astype(np.float32)
+    server = ModelServer(net, warmup=False, compute_dtype="bfloat16")
+    try:
+        out = np.asarray(server.predict(x))
+        ref = np.asarray(net.output(x))
+        assert out.dtype == np.float32  # head still activates in f32
+        # tolerance, not bit-identity: bf16 has ~3 decimal digits
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+        assert server.serving_compute_dtype == "bfloat16"
+    finally:
+        server.stop()
+
+
+def test_serving_metrics_carry_compute_dtype_label():
+    net = zoo.mnist_mlp(dtype=zoo.F32)
+    net.init(seed=5)
+    server = serve(net, port=0, warmup=False, compute_dtype="bfloat16")
+    try:
+        req = urllib.request.Request(server.url + "/metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            text = r.read().decode()
+        assert 'compute_dtype="bfloat16"' in text
+    finally:
+        server.stop()
+
+
+def test_serving_rejects_unknown_compute_dtype():
+    net = zoo.mnist_mlp(dtype=zoo.F32)
+    net.init(seed=5)
+    with pytest.raises(ValueError, match="float8"):
+        ModelServer(net, warmup=False, compute_dtype="float8")
+
+
+# ---------------------------------------------------------------------------
+# Convergence parity (slow): bf16 and f16 track the f32 trajectory
+# ---------------------------------------------------------------------------
+
+def _parity_run(policy, steps=120):
+    net = zoo.mnist_mlp(dtype=policy)
+    net.init(seed=42)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(128, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)]
+    ds = DataSet(x, y)
+    scores = [float(net.fit_batch(ds)) for _ in range(steps)]
+    return scores
+
+
+@pytest.mark.slow
+def test_bf16_converges_to_f32_parity():
+    f32 = _parity_run(zoo.F32)
+    bf16 = _parity_run(zoo.BF16)
+    assert f32[-1] < 0.5 * f32[0]  # the run actually learns
+    assert bf16[-1] < 0.5 * bf16[0]
+    # parity: final loss within 25% of the f32 trajectory's
+    assert bf16[-1] <= f32[-1] * 1.25 + 0.05
+
+
+@pytest.mark.slow
+def test_f16_trains_to_parity_through_loss_scaling():
+    f32 = _parity_run(zoo.F32)
+    f16 = _parity_run(zoo.F16)
+    assert f16[-1] < 0.5 * f16[0]
+    assert all(np.isfinite(f16))
+    assert f16[-1] <= f32[-1] * 1.25 + 0.05
