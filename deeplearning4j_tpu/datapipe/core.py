@@ -294,11 +294,24 @@ class PipelineStats:
         sec = MetricFamily("dl4j_datapipe_stage_seconds_total", "counter",
                            "Own processing seconds per stage (batch/fill "
                            "granularity)")
+        pad = MetricFamily("dl4j_datapipe_padding_waste_fraction", "gauge",
+                           "Padded timestep cells over total cells "
+                           "collated by pad-to-bucket stages")
+        padc = MetricFamily("dl4j_datapipe_padded_cells_total", "counter",
+                            "Filler timestep cells emitted by "
+                            "pad-to-bucket stages")
         for i, st in enumerate(self._pipeline.tail.chain()):
             sl = {**L, "stage": f"{i}:{st.name}"}
             rec.add(st.records_out, sl)
             sec.add(round(st.seconds, 6), sl)
+            real = getattr(st, "cells_real", None)
+            padded = getattr(st, "cells_padded", None)
+            if real is not None and padded is not None and real + padded:
+                pad.add(round(padded / (real + padded), 4), sl)
+                padc.add(padded, sl)
         fams.extend([rec, sec])
+        if pad.samples:
+            fams.extend([pad, padc])
         return fams
 
     def attach_to_registry(self, registry=None, *, labels=None):

@@ -369,6 +369,8 @@ class BucketBatchStage(Stage):
                                                          for x in ladder)
         self.drop_last = bool(drop_last)
         self._bufs = {}          # bucket_len -> list of records
+        self.cells_real = 0      # feature cells holding real timesteps
+        self.cells_padded = 0    # feature cells that are bucket filler
 
     def _bucket(self, t: int) -> int:
         if self.ladder is None:
@@ -380,6 +382,7 @@ class BucketBatchStage(Stage):
 
     def _collate(self, bucket: int, rows: List[tuple]) -> DataSet:
         t0 = time.perf_counter()
+        real_steps = 0
         with get_tracer().span("pipe_collate", n=len(rows), bucket=bucket):
             b = len(rows)
             f = np.asarray(rows[0][0]).shape[-1]
@@ -388,6 +391,7 @@ class BucketBatchStage(Stage):
             y = lmask = None
             for i, rec in enumerate(rows):
                 s = np.asarray(rec[0], np.float32)[:bucket]
+                real_steps += s.shape[0]
                 x[i, :s.shape[0]] = s
                 fmask[i, :s.shape[0]] = 1.0
                 if len(rec) > 1 and rec[1] is not None:
@@ -403,6 +407,14 @@ class BucketBatchStage(Stage):
                         if y is None:
                             y = np.zeros((b,) + l.shape, np.float32)
                         y[i] = l
+        # padding-waste accounting in timestep cells: b*bucket cells
+        # went to the device, real_steps of them carry data
+        padded_steps = b * bucket - real_steps
+        self.cells_real += real_steps
+        self.cells_padded += padded_steps
+        from deeplearning4j_tpu.observability import goodput as _goodput
+        _goodput.record_padding("datapipe_bucket_batch", real_steps,
+                                padded_steps)
         self._clock(t0)
         return DataSet(x, y, fmask, lmask)
 

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
@@ -567,6 +568,7 @@ class ComputationGraph:
             fmasks, lmasks, rng)
         self.iteration += n_steps
         self.score_value = score
+        _goodput.observe_steps(n_steps)
         return score
 
     @staticmethod
@@ -674,6 +676,8 @@ class ComputationGraph:
             if getattr(self, "_tbptt_step", None) is None:
                 self._tbptt_step = self._build_train_step()
             score_sum, weight = 0.0, 0
+            _dev_span = _get_tracer().span("device_step", tbptt=True)
+            _dev_span.__enter__()
             for start in range(0, t_total, L):
                 sl = slice(start, min(start + L, t_total))
                 inputs = {n: chunk(f, sl, lambda a: a.ndim == 3)
@@ -696,6 +700,7 @@ class ComputationGraph:
                 # pipeline once per chunk; consumers pull the final mean
                 score_sum = score_sum + chunk_score * w
                 weight += w
+            _dev_span.__exit__(None, None, None)
             self.state = self._strip_carries(self.state)
             score = score_sum / max(weight, 1)
         finally:
@@ -703,6 +708,7 @@ class ComputationGraph:
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = mds.num_examples
+        _goodput.observe_steps(1)
         with _get_tracer().span("score_sync"):
             for l in self.listeners:
                 l.iteration_done(self, self.iteration, self.epoch)
@@ -739,6 +745,10 @@ class ComputationGraph:
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = mds.num_examples
+        _goodput.observe_steps(1)
+        # post-dispatch: params hold fresh (undonated) outputs; inputs
+        # and labels were not donated, so lowering for cost is safe
+        self._maybe_derive_flops(inputs, labels, fmasks, lmasks)
         if self.listeners:
             t0 = time.perf_counter()
             for l in self.listeners:
@@ -747,6 +757,41 @@ class ComputationGraph:
             tracer.record("score_sync", t0, t1)
             _obs_metrics.observe_dispatch_lag(t1 - t0)
         return score
+
+    def _maybe_derive_flops(self, inputs, labels, fmasks, lmasks):
+        """Auto-derive per-step FLOPs from the XLA cost model on the
+        *lowered* train step — tracing only, no backend compile — once
+        per (train-step, batch-shapes) pair. See
+        MultiLayerNetwork._maybe_derive_flops."""
+        if not _goodput.auto_flops_enabled():
+            return
+        key = (id(self._train_step),
+               tuple(sorted((n, tuple(v.shape)) for n, v in inputs.items())),
+               tuple(tuple(l.shape) for l in labels),
+               tuple(sorted((n, tuple(v.shape))
+                            for n, v in (fmasks or {}).items())),
+               None if lmasks is None else tuple(
+                   None if m is None else tuple(m.shape) for m in lmasks))
+        if getattr(self, "_flops_key", None) == key:
+            return
+        self._flops_key = key
+        with _get_tracer().span("flops_derive"):
+            try:
+                if self._train_step is None:
+                    self._train_step = self._build_train_step()
+                from deeplearning4j_tpu.utils.perf import (
+                    xla_step_cost_lowered,
+                )
+                it = jnp.asarray(self.iteration, jnp.int32)
+                rng = jax.random.PRNGKey(0)
+                cost = xla_step_cost_lowered(
+                    self._train_step, self.params, self.state,
+                    self.opt_state, it, inputs, labels, fmasks, lmasks, rng)
+                self.flops_per_step = cost["flops"] or None
+            except Exception:
+                # meshed/wrapped steps have no .lower
+                self.flops_per_step = None
+        _goodput.observe_flops(self.flops_per_step)
 
     def fit(self, data, *, epochs: int = 1, async_prefetch: bool = True,
             device_prefetch="auto", multi_step="auto"):
@@ -762,11 +807,20 @@ class ComputationGraph:
         one jitted scan when no attached listener needs per-iteration
         values ("auto" = 8 on accelerators)."""
         if isinstance(data, (DataSet, MultiDataSet)):
-            items = [data]
-            for _ in range(epochs):
-                for d in items:
-                    self.fit_batch(d)
-                self.epoch += 1
+            _obs_metrics.install_runtime_metrics()
+            ledger = _goodput.start_run("fit", net=self)
+            status = "completed"
+            try:
+                items = [data]
+                for _ in range(epochs):
+                    for d in items:
+                        self.fit_batch(d)
+                    self.epoch += 1
+            except BaseException:
+                status = "failed"
+                raise
+            finally:
+                self.last_run_report = _goodput.end_run(ledger, status=status)
             return self
         from deeplearning4j_tpu.datasets.iterator import (
             AsyncDataSetIterator, DevicePrefetchIterator)
@@ -774,35 +828,43 @@ class ComputationGraph:
         device_prefetch = self._resolve_device_prefetch(device_prefetch)
         _obs_metrics.install_runtime_metrics()
         tracer = _get_tracer()
-        for _ in range(epochs):
-            source = data
-            if async_prefetch and hasattr(data, "reset"):
-                source = AsyncDataSetIterator(data)
-            if device_prefetch:
-                source = DevicePrefetchIterator(
-                    source, sharding=self._prefetch_sharding())
-            it0, t0 = self.iteration, time.perf_counter()
-            if chunk > 1:
-                self._fit_epoch_chunked(source, chunk)
-            else:
-                stream = iter(source)
-                while True:
-                    with tracer.span("data_wait"):
-                        d = next(stream, None)
-                    if d is None:
-                        break
-                    self.fit_batch(d)
-            _obs_metrics.observe_step(self.iteration - it0,
-                                      time.perf_counter() - t0)
-            if hasattr(data, "reset") and not getattr(data, "auto_epochs",
-                                                      False):
-                # datapipe Pipelines advance their own epoch state
-                # (seed + epoch shuffle orders); reset() would rewind
-                # them to epoch 0 every pass
-                data.reset()
-            for l in self.listeners:
-                l.on_epoch_end(self)
-            self.epoch += 1
+        ledger = _goodput.start_run("fit", net=self)
+        status = "completed"
+        try:
+            for _ in range(epochs):
+                source = data
+                if async_prefetch and hasattr(data, "reset"):
+                    source = AsyncDataSetIterator(data)
+                if device_prefetch:
+                    source = DevicePrefetchIterator(
+                        source, sharding=self._prefetch_sharding())
+                it0, t0 = self.iteration, time.perf_counter()
+                if chunk > 1:
+                    self._fit_epoch_chunked(source, chunk)
+                else:
+                    stream = iter(source)
+                    while True:
+                        with tracer.span("data_wait"):
+                            d = next(stream, None)
+                        if d is None:
+                            break
+                        self.fit_batch(d)
+                _obs_metrics.observe_rate(self.iteration - it0,
+                                          time.perf_counter() - t0)
+                if hasattr(data, "reset") and not getattr(data, "auto_epochs",
+                                                          False):
+                    # datapipe Pipelines advance their own epoch state
+                    # (seed + epoch shuffle orders); reset() would rewind
+                    # them to epoch 0 every pass
+                    data.reset()
+                for l in self.listeners:
+                    l.on_epoch_end(self)
+                self.epoch += 1
+        except BaseException:
+            status = "failed"
+            raise
+        finally:
+            self.last_run_report = _goodput.end_run(ledger, status=status)
         return self
 
     _FIT_CHUNK_DEFAULT = 8
@@ -915,6 +977,13 @@ class ComputationGraph:
         self.iteration += len(batches)
         self.score_value = scores[-1]
         self.last_batch_examples = batches[-1].num_examples
+        _goodput.observe_steps(len(batches))  # one dispatch, k real steps
+        # pre-stack arrays already have the per-step shape; slicing the
+        # stacked device arrays here would dispatch (and first-call
+        # compile) an XLA gather outside the flops_derive span
+        self._maybe_derive_flops(
+            prepared[0][0], list(batches[0].labels), prepared[0][1],
+            None if lmasks is None else list(batches[0].labels_masks))
         with tracer.span("score_sync", steps=len(batches)):
             self._replay_listeners(start, scores,
                                    [m.num_examples for m in batches])

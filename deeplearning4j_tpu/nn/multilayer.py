@@ -31,6 +31,7 @@ from deeplearning4j_tpu.datasets.iterator import (
     ListDataSetIterator,
 )
 from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -432,6 +433,7 @@ class MultiLayerNetwork:
         self.iteration += n_steps
         self.score_value = score
         self.last_batch_examples = ds.num_examples
+        _goodput.observe_steps(n_steps)
         return score
 
 
@@ -542,10 +544,43 @@ class MultiLayerNetwork:
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = ds.num_examples
+        _goodput.observe_steps(1)
         with _get_tracer().span("score_sync"):
             for l in self.listeners:
                 l.iteration_done(self, self.iteration, self.epoch)
         return score
+
+    def _maybe_derive_flops(self, x, y, fmask, lmask):
+        """Auto-derive per-step FLOPs from the XLA cost model on the
+        *lowered* train step — tracing only, no second backend compile —
+        the first time each (train-step, batch-shapes) pair is seen.
+        Feeds live dl4j_mfu / dl4j_flops_per_second with zero user
+        wiring; DL4J_TPU_AUTO_FLOPS=0 opts out."""
+        if not _goodput.auto_flops_enabled():
+            return
+        key = (id(self._train_step), tuple(x.shape), tuple(y.shape),
+               None if fmask is None else tuple(fmask.shape),
+               None if lmask is None else tuple(lmask.shape))
+        if getattr(self, "_flops_key", None) == key:
+            return
+        self._flops_key = key
+        with _get_tracer().span("flops_derive"):
+            try:
+                if self._train_step is None:
+                    self._train_step = self._build_train_step()
+                from deeplearning4j_tpu.utils.perf import (
+                    xla_step_cost_lowered,
+                )
+                it = jnp.asarray(self.iteration, jnp.int32)
+                rng = jax.random.PRNGKey(0)
+                cost = xla_step_cost_lowered(
+                    self._train_step, self.params, self.state,
+                    self.opt_state, it, x, y, fmask, lmask, rng)
+                self.flops_per_step = cost["flops"] or None
+            except Exception:
+                # meshed/wrapped steps have no .lower
+                self.flops_per_step = None
+        _goodput.observe_flops(self.flops_per_step)
 
     def fit_batch(self, ds: DataSet):
         """One optimization step on one minibatch (Model.fit parity)."""
@@ -572,6 +607,10 @@ class MultiLayerNetwork:
         self.iteration += 1
         self.score_value = score
         self.last_batch_examples = ds.num_examples
+        _goodput.observe_steps(1)
+        # after the dispatch: self.params holds fresh (undonated) outputs
+        # and x/y were not donated, so lowering for cost analysis is safe
+        self._maybe_derive_flops(x, y, fmask, lmask)
         if self.listeners:
             t0 = time.perf_counter()
             for l in self.listeners:
@@ -608,34 +647,42 @@ class MultiLayerNetwork:
         device_prefetch = self._resolve_device_prefetch(device_prefetch)
         _obs_metrics.install_runtime_metrics()
         tracer = _get_tracer()
-        for epoch in range(epochs):
-            source = AsyncDataSetIterator(it) if async_prefetch else it
-            if device_prefetch:
-                source = DevicePrefetchIterator(
-                    source, sharding=self._prefetch_sharding())
-            for l in self.listeners:
-                l.on_epoch_start(self)
-            it0, t0 = self.iteration, time.perf_counter()
-            if chunk > 1:
-                self._fit_epoch_chunked(source, chunk)
-            else:
-                stream = iter(source)
-                while True:
-                    with tracer.span("data_wait"):
-                        ds = next(stream, None)
-                    if ds is None:
-                        break
-                    self.fit_batch(ds)
-            _obs_metrics.observe_step(self.iteration - it0,
-                                      time.perf_counter() - t0)
-            for l in self.listeners:
-                l.on_epoch_end(self)
-            self.epoch += 1
-            if not getattr(it, "auto_epochs", False):
-                # datapipe Pipelines advance their own epoch state
-                # (seed + epoch shuffle orders); reset() would rewind
-                # them to epoch 0 every pass
-                it.reset()
+        ledger = _goodput.start_run("fit", net=self)
+        status = "completed"
+        try:
+            for epoch in range(epochs):
+                source = AsyncDataSetIterator(it) if async_prefetch else it
+                if device_prefetch:
+                    source = DevicePrefetchIterator(
+                        source, sharding=self._prefetch_sharding())
+                for l in self.listeners:
+                    l.on_epoch_start(self)
+                it0, t0 = self.iteration, time.perf_counter()
+                if chunk > 1:
+                    self._fit_epoch_chunked(source, chunk)
+                else:
+                    stream = iter(source)
+                    while True:
+                        with tracer.span("data_wait"):
+                            ds = next(stream, None)
+                        if ds is None:
+                            break
+                        self.fit_batch(ds)
+                _obs_metrics.observe_rate(self.iteration - it0,
+                                          time.perf_counter() - t0)
+                for l in self.listeners:
+                    l.on_epoch_end(self)
+                self.epoch += 1
+                if not getattr(it, "auto_epochs", False):
+                    # datapipe Pipelines advance their own epoch state
+                    # (seed + epoch shuffle orders); reset() would rewind
+                    # them to epoch 0 every pass
+                    it.reset()
+        except BaseException:
+            status = "failed"
+            raise
+        finally:
+            self.last_run_report = _goodput.end_run(ledger, status=status)
         return self
 
     _FIT_CHUNK_DEFAULT = 8
@@ -738,6 +785,13 @@ class MultiLayerNetwork:
         self.iteration += len(batches)
         self.score_value = scores[-1]
         self.last_batch_examples = batches[-1].num_examples
+        _goodput.observe_steps(len(batches))  # one dispatch, k real steps
+        # pre-stack arrays already have the per-step shape; slicing the
+        # stacked device arrays here would dispatch (and first-call
+        # compile) an XLA gather outside the flops_derive span
+        b0 = batches[0]
+        self._maybe_derive_flops(b0.features, b0.labels,
+                                 b0.features_mask, b0.labels_mask)
         with tracer.span("score_sync", steps=len(batches)):
             self._replay_listeners(start, scores,
                                    [b.num_examples for b in batches])

@@ -1,9 +1,14 @@
-"""Unified observability core: span tracing + cross-runtime metrics.
+"""Unified observability core: span tracing + cross-runtime metrics +
+goodput attribution.
 
 ``trace`` answers "where did step N spend its time" (bounded-ring span
 tracer, Chrome-trace/JSONL export, per-thread Perfetto lanes);
 ``metrics`` is the single registry every runtime feeds (Prometheus text
-exposition + JSON snapshot). See OBSERVABILITY.md.
+exposition + JSON snapshot); ``goodput`` turns both into efficiency
+accounting — a per-run wall-time ledger, live MFU/goodput gauges with
+auto-derived FLOPs, padding-waste fractions, and the RunReport JSON
+artifact that scripts/check_budgets.py gates CI on. See
+OBSERVABILITY.md.
 """
 
 from deeplearning4j_tpu.observability.trace import (  # noqa: F401
@@ -12,14 +17,23 @@ from deeplearning4j_tpu.observability.trace import (  # noqa: F401
 )
 from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
     MetricFamily, MetricsRegistry, get_registry, set_registry,
-    install_runtime_metrics, observe_step, observe_dispatch_lag,
-    compile_stats,
+    install_runtime_metrics, observe_step, observe_rate,
+    observe_dispatch_lag, compile_stats, update_memory_watermark,
+    memory_watermark_bytes,
+)
+from deeplearning4j_tpu.observability.goodput import (  # noqa: F401
+    EfficiencyLedger, RunReport, start_run, end_run, current_ledger,
+    last_report, record_padding, live_snapshot, goodput_collector,
 )
 
 __all__ = [
     "Span", "Tracer", "get_tracer", "set_tracer", "span", "trace_span",
     "trace_timeline_component", "export_trace_html", "span_color",
     "MetricFamily", "MetricsRegistry", "get_registry", "set_registry",
-    "install_runtime_metrics", "observe_step", "observe_dispatch_lag",
-    "compile_stats",
+    "install_runtime_metrics", "observe_step", "observe_rate",
+    "observe_dispatch_lag", "compile_stats", "update_memory_watermark",
+    "memory_watermark_bytes",
+    "EfficiencyLedger", "RunReport", "start_run", "end_run",
+    "current_ledger", "last_report", "record_padding", "live_snapshot",
+    "goodput_collector",
 ]

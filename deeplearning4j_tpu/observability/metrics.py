@@ -404,6 +404,10 @@ _COMPILE = {"count": 0, "seconds": 0.0}
 _COMPILE_LISTENER_ON = False
 _RUNTIME_INSTALLED_ON: Optional[MetricsRegistry] = None
 _STEPS = {"count": 0.0, "per_sec": 0.0, "dispatch_lag_s": 0.0}
+# memory high-water marks, updated on every watermark sample
+# (render-time scrape, observe_rate, goodput run start/end — never on
+# the per-step hot path): device key -> peak bytes_in_use seen
+_MEM_PEAK: dict = {}
 
 
 def _on_jax_event_duration(event: str, duration: float, **kw):
@@ -475,7 +479,42 @@ def _runtime_collector() -> List[MetricFamily]:
             mem.add(rss, {"device": "process", "kind": "host_rss_bytes"})
     if mem.samples:
         fams.append(mem)
+    update_memory_watermark()
+    with _runtime_lock:
+        peaks = dict(_MEM_PEAK)
+    if peaks:
+        peak_fam = MetricFamily(
+            "dl4j_device_memory_peak_bytes", "gauge",
+            "High-water memory mark per device: max peak_bytes_in_use "
+            "from Device.memory_stats() across watermark samples; CPU "
+            "falls back to the process VmHWM RSS high-water mark")
+        for dev, v in sorted(peaks.items()):
+            peak_fam.add(v, {"device": dev})
+        fams.append(peak_fam)
+    fams.extend(_trace_drop_families())
     return fams
+
+
+def _trace_drop_families() -> List[MetricFamily]:
+    """dl4j_trace_dropped_spans_total: ring-buffer data loss made
+    visible — per evicted/sampled span name, plus the process total."""
+    try:
+        from deeplearning4j_tpu.observability.trace import get_tracer
+        tracer = get_tracer()
+        total = tracer.dropped
+        by_name = tracer.dropped_spans()
+    except Exception:
+        return []
+    if not total and not by_name:
+        return []
+    fam = MetricFamily(
+        "dl4j_trace_dropped_spans_total", "counter",
+        "Spans lost to tracer ring eviction or sampling, by span name "
+        "(the 'total' label-less sample is the process-wide count)")
+    fam.add(total)
+    for name, n in sorted(by_name.items()):
+        fam.add(n, {"span": name})
+    return [fam]
 
 
 def _host_rss_bytes() -> Optional[float]:
@@ -487,6 +526,58 @@ def _host_rss_bytes() -> Optional[float]:
     except OSError:
         return None
     return None
+
+
+def _host_hwm_bytes() -> Optional[float]:
+    """Kernel-tracked RSS high-water mark (VmHWM) — the honest host
+    watermark, no sampling cadence required."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return float(line.split()[1]) * 1024.0
+    except OSError:
+        return None
+    return None
+
+
+def update_memory_watermark() -> None:
+    """Fold the current device memory state into the high-water table.
+    Called at scrape time, epoch boundaries and goodput run start/end —
+    deliberately NOT per-step (a /proc read per step would eat the
+    trace-overhead budget)."""
+    reported = False
+    try:
+        import jax
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+            if peak is None:
+                continue
+            dev = f"{d.platform}:{d.id}"
+            with _runtime_lock:
+                if peak > _MEM_PEAK.get(dev, 0.0):
+                    _MEM_PEAK[dev] = float(peak)
+            reported = True
+    except Exception:
+        pass
+    if reported:
+        return
+    hwm = _host_hwm_bytes() or _host_rss_bytes()
+    if hwm is not None:
+        with _runtime_lock:
+            if hwm > _MEM_PEAK.get("process", 0.0):
+                _MEM_PEAK["process"] = float(hwm)
+
+
+def memory_watermark_bytes() -> Optional[float]:
+    """The single-number memory watermark (max across devices) the
+    RunReport records. Samples current state first."""
+    update_memory_watermark()
+    with _runtime_lock:
+        return max(_MEM_PEAK.values()) if _MEM_PEAK else None
 
 
 def install_runtime_metrics(
@@ -503,6 +594,11 @@ def install_runtime_metrics(
             return reg
         _RUNTIME_INSTALLED_ON = reg
     reg.register_collector(_runtime_collector)
+    try:  # the goodput gauges ride along wherever runtime metrics go
+        from deeplearning4j_tpu.observability.goodput import goodput_collector
+        reg.register_collector(goodput_collector)
+    except Exception:
+        pass
     return reg
 
 
@@ -511,6 +607,15 @@ def observe_step(n: int = 1, wall_s: Optional[float] = None):
     wall-clock the caller measured for those n steps."""
     with _runtime_lock:
         _STEPS["count"] += n
+        if wall_s and wall_s > 0:
+            _STEPS["per_sec"] = n / wall_s
+
+
+def observe_rate(n: int, wall_s: Optional[float]):
+    """Update the steps/sec gauge WITHOUT advancing steps_total — the
+    fit loops count steps per dispatch (k per lax.scan chunk) via
+    goodput.observe_steps and report the epoch-level rate here."""
+    with _runtime_lock:
         if wall_s and wall_s > 0:
             _STEPS["per_sec"] = n / wall_s
 

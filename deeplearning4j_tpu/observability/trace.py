@@ -142,6 +142,8 @@ class Tracer:
         self._epoch = time.perf_counter()
         self._seen: dict = {}       # name -> occurrence count (sampling)
         self.dropped = 0            # spans evicted or sampled away
+        self._dropped_by_name: dict = {}  # name -> drop count
+        self._sinks: list = []      # fns called with each recorded Span
 
     # ------------------------------------------------------------ recording
     def span(self, name: str, **attrs):
@@ -168,12 +170,42 @@ class Tracer:
                 self._seen[name] = seen + 1
                 if seen % self.sample_every:
                     self.dropped += 1
+                    self._dropped_by_name[name] = \
+                        self._dropped_by_name.get(name, 0) + 1
                     return
             if len(self._ring) == self.capacity:
+                # ring eviction loses the OLDEST span — count its name,
+                # not the incoming one, so the drop table says which
+                # phase's history actually scrolled off
                 self.dropped += 1
-            self._ring.append(Span(
+                evicted = self._ring[0].name
+                self._dropped_by_name[evicted] = \
+                    self._dropped_by_name.get(evicted, 0) + 1
+            span = Span(
                 name, (t0 - self._epoch) * 1e6, (t1 - t0) * 1e6,
-                tid, thread or "", attrs))
+                tid, thread or "", attrs)
+            self._ring.append(span)
+            sinks = self._sinks
+        for sink in sinks:
+            try:
+                sink(span)
+            except Exception:
+                pass  # a broken sink must never break the hot path
+
+    # ---------------------------------------------------------------- sinks
+    def add_sink(self, fn) -> None:
+        """Register a callable invoked with every recorded Span (outside
+        the ring lock; exceptions swallowed). Sinks see spans even when
+        the ring later evicts them — the goodput ledger's feed."""
+        with self._lock:
+            if fn not in self._sinks:
+                # copy-on-write: _record iterates a snapshot lock-free
+                self._sinks = self._sinks + [fn]
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks = [s for s in self._sinks if s is not fn]
 
     # -------------------------------------------------------------- control
     def enable(self):
@@ -189,6 +221,14 @@ class Tracer:
             self._ring.clear()
             self._seen.clear()
             self.dropped = 0
+            self._dropped_by_name = {}
+
+    # ------------------------------------------------------------ drop stats
+    def dropped_spans(self) -> dict:
+        """Per-name dropped-span counts (ring eviction counts the
+        evicted span's name; sampling counts the sampled-away name)."""
+        with self._lock:
+            return dict(self._dropped_by_name)
 
     # --------------------------------------------------------------- export
     def spans(self) -> List[Span]:
@@ -219,7 +259,16 @@ class Tracer:
         meta = [{"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                  "args": {"name": name or f"thread-{tid}"}}
                 for tid, name in sorted(threads.items())]
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        out = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        dropped = self.dropped_spans()
+        if self.dropped or dropped:
+            # stamp data loss into the artifact: a timeline missing its
+            # oldest spans should say so rather than look complete
+            out["otherData"] = {
+                "dropped_spans_total": self.dropped,
+                "dropped_spans_by_name": dropped,
+            }
+        return out
 
     def export_chrome_trace(self, path: str) -> str:
         with open(path, "w") as f:

@@ -99,14 +99,25 @@ class PerformanceListener(TrainingListener):
     needs_per_iteration = True  # measures real wall-clock per step
 
     def __init__(self, frequency: int = 10, report_examples: bool = True,
-                 flops_per_step: float | None = None):
+                 flops_per_step: float | None = None,
+                 report_mfu: bool = False):
         self.frequency = max(1, frequency)
         self.report_examples = report_examples
         self.flops_per_step = flops_per_step  # net.step_cost_analysis(ds)["flops"]
+        # report_mfu without explicit flops: use the FLOPs the fit loop
+        # auto-derived from the lowered cost model (net.flops_per_step)
+        self.report_mfu = bool(report_mfu) or flops_per_step is not None
         self.records: list[dict] = []
         self._last_time = None
         self._last_iter = None
         self._examples = 0
+
+    def _resolve_flops(self, net) -> float | None:
+        if self.flops_per_step:
+            return self.flops_per_step
+        if self.report_mfu:
+            return getattr(net, "flops_per_step", None)
+        return None
 
     def _peak(self):
         import jax
@@ -136,10 +147,11 @@ class PerformanceListener(TrainingListener):
                 rec["examples_per_sec"] = (
                     self._examples / dt if dt > 0 else float("inf"))
                 msg += f", {rec['examples_per_sec']:.1f} examples/s"
-            if self.flops_per_step and dt > 0:
+            flops = self._resolve_flops(net)
+            if flops and dt > 0:
                 peak = self._peak()
                 if peak:
-                    mfu = self.flops_per_step * iters / dt / peak
+                    mfu = flops * iters / dt / peak
                     if 0.0 < mfu <= 1.0:  # never publish impossible MFU
                         rec["mfu"] = mfu
                         msg += f", MFU {100 * mfu:.1f}%"

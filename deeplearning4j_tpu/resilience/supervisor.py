@@ -40,11 +40,13 @@ import math
 import os
 import shutil
 import signal
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 
@@ -205,6 +207,10 @@ class SupervisorResult:
     resumed_from: Optional[str]
     events: List[RecoveryEvent]
     stats: dict
+    #: goodput.RunReport for the whole supervised run (None when the
+    #: goodput engine is disabled); also saved as run_report.json in
+    #: the checkpoint dir
+    report: Optional[object] = None
 
 
 class TrainingSupervisor:
@@ -507,6 +513,7 @@ class TrainingSupervisor:
         self.stats.attach_to_registry(
             labels={"job": os.path.basename(
                 os.path.normpath(cfg.checkpoint_dir))})
+        ledger = _goodput.start_run("resilient_fit", net=net)
 
         if cfg.resume:
             latest = find_latest_checkpoint(cfg.checkpoint_dir)
@@ -588,11 +595,18 @@ class TrainingSupervisor:
             # clean paths the writer was drained above (wait=True saves),
             # so this is a no-op.
             self._drain_checkpoint(raise_errors=False)
+            if sys.exc_info()[0] is not None:
+                # exception path: still close the ledger (end_run is
+                # idempotent, so the clean-path call below stays a no-op)
+                _goodput.end_run(ledger, status="failed")
 
+        report = _goodput.end_run(
+            ledger, status=status,
+            save_to=os.path.join(cfg.checkpoint_dir, "run_report.json"))
         return SupervisorResult(
             status=status, final_step=net.iteration,
             resumed_from=resumed_from, events=list(self.events),
-            stats=self.stats.snapshot())
+            stats=self.stats.snapshot(), report=report)
 
     # ------------------------------------------------------- pipeline loop
     def fit_pipeline(self, pipeline, *, epochs: int = 1) -> SupervisorResult:
@@ -618,6 +632,7 @@ class TrainingSupervisor:
         self.stats.attach_to_registry(
             labels={"job": os.path.basename(
                 os.path.normpath(cfg.checkpoint_dir))})
+        ledger = _goodput.start_run("resilient_fit", net=net)
 
         if cfg.resume:
             latest = find_latest_checkpoint(cfg.checkpoint_dir)
@@ -716,11 +731,16 @@ class TrainingSupervisor:
             # don't accumulate stale families in the global registry
             pipeline.stats.detach_from_registry()
             self._drain_checkpoint(raise_errors=False)
+            if sys.exc_info()[0] is not None:
+                _goodput.end_run(ledger, status="failed")
 
+        report = _goodput.end_run(
+            ledger, status=status,
+            save_to=os.path.join(cfg.checkpoint_dir, "run_report.json"))
         return SupervisorResult(
             status=status, final_step=net.iteration,
             resumed_from=resumed_from, events=list(self.events),
-            stats=self.stats.snapshot())
+            stats=self.stats.snapshot(), report=report)
 
     # ----------------------------------------------------------- fit facade
     def fit(self, data, labels=None, *, epochs: int = 1,

@@ -50,6 +50,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 
 
@@ -290,6 +291,9 @@ class MicroBatcher:
             return
         if self.stats is not None:
             self.stats.record_batch(bucket, rows, len(batch))
+        # padding-waste accounting: bucket - rows filler rows rode this
+        # device forward (goodput ledger + dl4j_padding_waste_fraction)
+        _goodput.record_padding("serving_bucket", rows, bucket - rows)
         many = isinstance(out, (list, tuple))
         outs = [np.asarray(o) for o in out] if many else [np.asarray(out)]
         off = 0

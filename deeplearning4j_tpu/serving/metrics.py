@@ -42,6 +42,7 @@ class ServingStats:
         self.errors = 0                     # 400 request failures
         self.timeouts = 0                   # 504 per-request deadline expiries
         self.batch_hist: dict[int, int] = {}  # executed bucket -> count
+        self.padded_rows = 0                # filler rows across forwards
         self.queue_depth_fn = lambda: 0     # wired by the dispatcher
 
     # ------------------------------------------------------------- recording
@@ -57,6 +58,7 @@ class ServingStats:
             self.batches += 1
             self.batch_rows += int(rows)
             self.batch_requests += int(n_tickets)
+            self.padded_rows += max(0, int(bucket) - int(rows))
             self.batch_hist[int(bucket)] = self.batch_hist.get(int(bucket),
                                                                0) + 1
 
@@ -109,6 +111,13 @@ class ServingStats:
                 "coalesce_requests_per_batch": (
                     round(self.batch_requests / batches, 3) if batches
                     else None),
+                # filler rows the bucket ladder padded in, and their
+                # share of every row that rode a device forward
+                "padded_rows_total": self.padded_rows,
+                "padding_waste_fraction": (
+                    round(self.padded_rows
+                          / (self.batch_rows + self.padded_rows), 4)
+                    if self.batch_rows + self.padded_rows else None),
                 "compile_count": len(shapes_seen),
                 "shapes_seen": sorted(int(s) for s in shapes_seen),
             }
@@ -168,6 +177,13 @@ class ServingStats:
             fam("dl4j_serving_coalesce_requests_per_batch", "gauge",
                 "Mean tickets per device forward",
                 snap["coalesce_requests_per_batch"])
+        fam("dl4j_serving_padded_rows_total", "counter",
+            "Filler rows added by bucket-ladder padding",
+            snap["padded_rows_total"])
+        if snap["padding_waste_fraction"] is not None:
+            fam("dl4j_serving_padding_waste_fraction", "gauge",
+                "Padded rows over total rows through device forwards",
+                snap["padding_waste_fraction"])
         fam("dl4j_serving_compiled_buckets", "gauge",
             "Distinct padded bucket shapes executed (XLA compile-cache "
             "footprint of the bucket ladder)", snap["compile_count"])

@@ -42,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError,
@@ -77,6 +78,8 @@ class ModelServer:
         self.request_timeout_s = float(request_timeout_s)
         self._httpd = None
         self._thread = None
+        self._ledger = None
+        self.run_report = None  # goodput RunReport, set by stop()
         self._is_graph = hasattr(net, "conf") and hasattr(
             net.conf, "network_inputs")
         # Serving precision contract (PRECISION.md / SERVING.md):
@@ -345,6 +348,7 @@ class ModelServer:
             labels={"server": f"{self.host}:{self.port}",
                     "compute_dtype": self.serving_compute_dtype},
             shapes_fn=lambda: self.shapes_seen)
+        self._ledger = _goodput.start_run("serving", net=self.net)
         import threading
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -361,13 +365,17 @@ class ModelServer:
 
     def stop(self):
         """Stop accepting, then drain: every accepted ticket completes
-        before the device thread exits."""
+        before the device thread exits. Closes the serving goodput
+        ledger — ``self.run_report`` holds the RunReport afterwards."""
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
         self._batcher.stop()
         self.stats.detach_from_registry()
+        report = _goodput.end_run(getattr(self, "_ledger", None))
+        if report is not None:  # stop() is idempotent; keep the first
+            self.run_report = report
 
 
 def serve(net, host: str = "127.0.0.1", port: int = 9500,

@@ -89,6 +89,13 @@ select{margin-left:12px}
     style="height:auto"></svg><div id="tracelegend" class="label"></div>
  </div>
 </div>
+<div class="row">
+ <div class="card" id="goodputcard" style="display:none">
+   <h3>Goodput &amp; efficiency <span id="goodputsrc" class="label"></span>
+   </h3><div id="goodputstats"></div><svg id="goodputbar"
+    style="height:34px"></svg><div id="goodputlegend" class="label"></div>
+ </div>
+</div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -168,6 +175,60 @@ async function refresh(){
   await refreshFlow(sess, m.activation_stats || {});
   await refreshPhases(sess);
   await refreshTrace();
+  await refreshGoodput();
+}
+async function refreshGoodput(){
+  // the efficiency ledger next to the trace timeline: headline gauges
+  // (goodput %, MFU, FLOP/s, steps) + a single stacked wall-time bar
+  // attributing the run across traced phases (/api/goodput serves the
+  // live ledger during a run, the last RunReport after it)
+  const g = await (await fetch("/api/goodput")).json();
+  const card = document.getElementById("goodputcard");
+  if (!g || g.source === "none" || !g.wall_s){
+    card.style.display = "none"; return; }
+  card.style.display = "";
+  document.getElementById("goodputsrc").textContent =
+    `(${g.kind || "run"} · ${g.source === "live" ? "live" : "last run"})`;
+  const pct = v => v == null ? "—" : (100*v).toFixed(1)+"%";
+  const num = v => v == null ? "—" : Number(v).toPrecision(3);
+  document.getElementById("goodputstats").innerHTML =
+    `<span class="stat">${pct(g.goodput_fraction)}</span>
+     <span class="label">goodput</span> &nbsp;
+     <span class="stat">${pct(g.mfu)}</span>
+     <span class="label">MFU</span> &nbsp;
+     <span class="stat">${g.flops_per_second ?
+        num(g.flops_per_second/1e9)+" G" : "—"}</span>
+     <span class="label">FLOP/s</span> &nbsp;
+     <span class="stat">${g.steps ?? "—"}</span>
+     <span class="label">steps</span> &nbsp;
+     <span class="stat">${num(g.wall_s)}s</span>
+     <span class="label">wall</span>`;
+  const phases = g.phases || {};
+  const names = Object.keys(phases).sort(
+    (a,b)=>phases[b].seconds - phases[a].seconds);
+  const el = document.getElementById("goodputbar");
+  if (!names.length){ el.innerHTML = "";
+    document.getElementById("goodputlegend").innerHTML = ""; return; }
+  const W = el.clientWidth || 760, H = 34;
+  el.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  let x = 0, html = "";
+  const total = Math.max(g.wall_s, 1e-9);
+  names.forEach(n=>{
+    const w = W * phases[n].seconds / total;
+    html += `<rect x="${x.toFixed(1)}" y="4" width="${Math.max(w,0.5)
+      .toFixed(1)}" height="${H-8}" fill="${spanColor(n)}"`+
+      ` fill-opacity="0.85"><title>${esc(n)} ${phases[n].seconds
+      .toFixed(3)}s</title></rect>`;
+    x += w;
+  });
+  if (x < W) html += `<rect x="${x.toFixed(1)}" y="4" width="${(W-x)
+    .toFixed(1)}" height="${H-8}" fill="#ddd">`+
+    `<title>untracked</title></rect>`;
+  el.innerHTML = html;
+  document.getElementById("goodputlegend").innerHTML =
+    names.map(n=>`<span style="color:${spanColor(n)}">&#9632; `+
+      `${esc(n)} ${phases[n].seconds.toFixed(2)}s</span>`).join(" &nbsp;")+
+    ' <span style="color:#999">&#9632; untracked</span>';
 }
 const TRACE_PALETTE=["#1f77b4","#ff7f0e","#2ca02c","#d93025","#9334e6",
   "#8c564b","#e377c2","#7f7f7f","#bcbd22","#12858d"];
@@ -462,6 +523,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/trace":
             from deeplearning4j_tpu.observability.trace import get_tracer
             self._json(get_tracer().to_chrome_trace())
+        elif url.path == "/api/goodput":
+            from deeplearning4j_tpu.observability import goodput
+            self._json(goodput.live_snapshot())
         else:
             self._json({"error": "not found"}, 404)
 

@@ -4,6 +4,8 @@ the networks' ``step_cost_analysis`` (SURVEY.md §5.1)."""
 
 from __future__ import annotations
 
+import os
+
 # bf16 matmul peak FLOP/s by device kind prefix (public spec numbers)
 PEAK_FLOPS = {
     "TPU v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
@@ -15,6 +17,16 @@ PEAK_FLOPS = {
 
 
 def peak_flops(device) -> float | None:
+    """Peak FLOP/s for the MFU denominator. The DL4J_TPU_PEAK_FLOPS env
+    override wins over the table — it is the only way to get an MFU
+    number on devices without an honest spec entry (CPU), and lets TPU
+    users pin the f32 vs bf16 peak they are actually comparing against."""
+    override = os.environ.get("DL4J_TPU_PEAK_FLOPS")
+    if override:
+        try:
+            return float(override)
+        except ValueError:
+            pass
     kind = getattr(device, "device_kind", "")
     for prefix, peak in PEAK_FLOPS.items():
         if kind.startswith(prefix):
@@ -30,6 +42,23 @@ def xla_step_cost(jitted_step, *args) -> dict:
         raise NotImplementedError(
             "cost analysis needs a plain jitted step (meshed nets wrap it)")
     cost = jitted_step.lower(*args).compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    cost = cost or {}
+    return {"flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+
+
+def xla_step_cost_lowered(jitted_step, *args) -> dict:
+    """Like :func:`xla_step_cost` but from the *lowered* (pre-backend-
+    compile) module — pure tracing, no second XLA compilation, so the
+    fit loops can auto-derive per-step FLOPs at step-build time without
+    doubling compile cost. Same return shape; flops matches the compiled
+    path on jax 0.4.x. Raises NotImplementedError for wrapped steps."""
+    if not hasattr(jitted_step, "lower"):
+        raise NotImplementedError(
+            "cost analysis needs a plain jitted step (meshed nets wrap it)")
+    cost = jitted_step.lower(*args).cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
     cost = cost or {}

@@ -1,0 +1,161 @@
+"""CI budget gate: assert a RunReport (or bench result JSON) against
+the committed efficiency budgets in BUDGETS.json.
+
+Budgets are grouped into sections keyed by the report's ``kind`` (for
+RunReports: "fit" / "resilient_fit" / "serving") or the bench result's
+``config`` name ("goodput_overhead", "trace_overhead", ...). Inside a
+section every key follows the ``min_<field>`` / ``max_<field>``
+convention:
+
+    "fit": {
+        "min_goodput_fraction": 0.30,   # report.goodput_fraction >= 0.30
+        "max_compile_count": 32,        # report.compile_count <= 32
+        "max_untracked_fraction": 0.25  # derived: untracked_s / wall_s
+    }
+
+Fields that are absent or null in the report are SKIPPED, not failed —
+e.g. ``min_mfu`` only gates on hardware where peak FLOP/s is known.
+Keys starting with "_" are comments. Derived fields available beyond
+the raw RunReport keys: ``untracked_fraction``, ``attributed_fraction``
+(attributed_s / wall_s) and ``padding_waste_fraction`` (worst source).
+
+Usage:
+    python scripts/check_budgets.py --report run_report.json
+    python scripts/check_budgets.py --report rr.json --section fit
+    python scripts/check_budgets.py --bench goodput_overhead.json
+    python scripts/check_budgets.py --report rr.json --budgets MY.json
+
+Exit status 0 = all budgets hold, 1 = at least one violated (each
+violation printed on its own line), 2 = usage / unreadable input.
+The test suite runs this end-to-end on a tiny-model fit
+(tests/test_goodput.py) so a budget regression fails CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BUDGETS = os.path.join(_REPO, "BUDGETS.json")
+
+
+def _resolve(report: dict, field: str) -> Optional[float]:
+    """A budget field -> its numeric value in the report, or None when
+    the report doesn't carry it (skip, don't fail)."""
+    if field == "untracked_fraction":
+        wall = report.get("wall_s")
+        return (report.get("untracked_s", 0.0) / wall) if wall else None
+    if field == "attributed_fraction":
+        wall = report.get("wall_s")
+        return (report.get("attributed_s", 0.0) / wall) if wall else None
+    if field == "padding_waste_fraction":
+        # RunReport carries per-source padding; gate on the worst one.
+        # Bench/summary dicts may carry the scalar directly.
+        pad = report.get("padding")
+        if isinstance(pad, dict) and pad:
+            return max(e.get("waste_fraction", 0.0) for e in pad.values())
+        val = report.get("padding_waste_fraction")
+        return float(val) if val is not None else None
+    val = report.get(field)
+    if val is None or isinstance(val, (dict, list, str)):
+        return None
+    return float(val)
+
+
+def check_report(report: dict, budgets: dict) -> List[str]:
+    """Evaluate one budget section against one report dict; returns a
+    list of human-readable violation strings (empty = all green)."""
+    violations: List[str] = []
+    for key, bound in budgets.items():
+        if key.startswith("_"):
+            continue
+        if key.startswith("min_"):
+            field, op = key[4:], "min"
+        elif key.startswith("max_"):
+            field, op = key[4:], "max"
+        else:
+            continue  # unknown convention: ignore, stays forward-compatible
+        value = _resolve(report, field)
+        if value is None:
+            continue
+        bound = float(bound)
+        if op == "min" and value < bound:
+            violations.append(
+                f"{field} = {value:.6g} below budget min {bound:.6g}")
+        elif op == "max" and value > bound:
+            violations.append(
+                f"{field} = {value:.6g} above budget max {bound:.6g}")
+    return violations
+
+
+def _section_for(report: dict, budgets: dict,
+                 override: Optional[str]) -> Optional[str]:
+    if override:
+        return override
+    for key in ("kind", "config"):
+        name = report.get(key)
+        if name and name in budgets:
+            return name
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--budgets", default=DEFAULT_BUDGETS,
+                    help=f"budgets file (default: {DEFAULT_BUDGETS})")
+    ap.add_argument("--report", default=None,
+                    help="RunReport JSON (from fit / resilient_fit / "
+                         "serving drain, or DL4J_TPU_RUN_REPORT_DIR)")
+    ap.add_argument("--bench", default=None,
+                    help="bench result JSON with a 'config' key (e.g. "
+                         "perf_probe/serve_bench output)")
+    ap.add_argument("--section", default=None,
+                    help="budget section to apply (default: the "
+                         "report's 'kind' or the bench's 'config')")
+    args = ap.parse_args(argv)
+
+    if not args.report and not args.bench:
+        print("check_budgets: need --report or --bench", file=sys.stderr)
+        return 2
+    path = args.report or args.bench
+    try:
+        with open(path) as f:
+            report = json.load(f)
+        with open(args.budgets) as f:
+            budgets = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_budgets: {e}", file=sys.stderr)
+        return 2
+
+    # a serve_bench.py --out file: gate the embedded drain RunReport,
+    # folding in the summary rollup (p99, rows/sec, waste fraction)
+    if "kind" not in report and "config" not in report \
+            and isinstance(report.get("run_report"), dict):
+        merged = dict(report["run_report"])
+        merged.update(report.get("summary") or {})
+        report = merged
+
+    section = _section_for(report, budgets, args.section)
+    if section is None or section not in budgets:
+        print(f"check_budgets: no budget section for "
+              f"kind/config {report.get('kind') or report.get('config')!r} "
+              f"in {args.budgets} (use --section)", file=sys.stderr)
+        return 2
+
+    violations = check_report(report, budgets[section])
+    if violations:
+        for v in violations:
+            print(f"BUDGET VIOLATION [{section}]: {v}")
+        return 1
+    checked = sum(1 for k in budgets[section]
+                  if k.startswith(("min_", "max_")))
+    print(f"budgets OK [{section}]: {checked} bounds checked, 0 violated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,17 +19,18 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from bench import (_peak_flops, bench_host_loop, bench_input_pipeline,
-                   bench_mixed_precision, bench_trace_overhead,
-                   calibrated_step_time)
+from bench import (_peak_flops, bench_goodput_overhead, bench_host_loop,
+                   bench_input_pipeline, bench_mixed_precision,
+                   bench_trace_overhead, calibrated_step_time)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=["resnet50", "lenet", "char_rnn",
                                        "mnist_mlp", "resnet18", "host_loop",
-                                       "trace_overhead", "input_pipeline",
-                                       "mixed_precision"])
+                                       "trace_overhead", "goodput_overhead",
+                                       "input_pipeline", "mixed_precision",
+                                       "serving"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
@@ -44,6 +45,10 @@ def main():
                     help="record the probe run in the span tracer and "
                     "export a Chrome trace-event file (open in Perfetto "
                     "or chrome://tracing)")
+    ap.add_argument("--serving-results", metavar="RESULTS.json", default=None,
+                    help="serving config: summarize an existing "
+                    "serve_bench.py --out file instead of re-running the "
+                    "load generator")
     args = ap.parse_args()
 
     tracer = None
@@ -67,6 +72,42 @@ def main():
         out = {"config": "trace_overhead"}
         out.update(bench_trace_overhead(
             batch=batch, n_batches=args.n_batches, epochs=args.epochs))
+        finish(out)
+        return
+
+    if args.config == "goodput_overhead":
+        # ledger on/off steps-per-sec guard: tracer stays ON in both
+        # arms so the number isolates the goodput sink + FLOPs
+        # derivation, not the span tracer itself (< 3% budget)
+        batch = args.batch if args.batch != 256 else 1024
+        out = {"config": "goodput_overhead"}
+        out.update(bench_goodput_overhead(
+            batch=batch, n_batches=args.n_batches, epochs=args.epochs))
+        finish(out)
+        return
+
+    if args.config == "serving":
+        # the serving round: either summarize a serve_bench.py --out
+        # results file (--serving-results) or run the quick load
+        # generator inline; the headline is the "summary" rollup
+        # (p50/p99, rows/sec, coalesce ratio, padding-waste fraction)
+        out = {"config": "serving"}
+        if args.serving_results:
+            with open(args.serving_results) as f:
+                rep = json.load(f)
+            out["results_file"] = args.serving_results
+        else:
+            from serve_bench import bench_serving
+            rep = bench_serving(concurrencies=(16,), requests_per_client=10)
+        out["model"] = rep.get("model")
+        out.update(rep.get("summary") or {})
+        for k, v in rep.items():
+            if k.startswith("speedup_"):
+                out[k] = v
+        if rep.get("run_report"):
+            rr = rep["run_report"]
+            out["goodput_fraction"] = rr.get("goodput_fraction")
+            out["device_s"] = rr.get("device_s")
         finish(out)
         return
 

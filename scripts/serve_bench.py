@@ -230,12 +230,32 @@ def bench_serving(concurrencies=(1, 8, 64), requests_per_client=25,
         report["metrics"] = server.metrics()
     finally:
         server.stop()
+    if server.run_report is not None:
+        # the serving goodput ledger closed on drain: device-time share
+        # and the bucket ladder's padding waste ride the results file
+        report["run_report"] = server.run_report.to_dict()
 
     for c in concurrencies:
         a = report["serialized"][f"c{c}"].get("rows_per_sec")
         b = report["coalesced"][f"c{c}"].get("rows_per_sec")
         if a and b:
             report[f"speedup_c{c}"] = round(b / a, 2)
+
+    # headline rollup for downstream consumers (perf_probe, budgets):
+    # worst-case p99 + best rows/sec across the coalesced runs, plus the
+    # batcher's coalesce ratio and padding-waste fraction
+    coal = [v for v in report["coalesced"].values() if "p99_ms" in v]
+    if coal:
+        report["summary"] = {
+            "p50_ms": min(v["p50_ms"] for v in coal),
+            "p99_ms": max(v["p99_ms"] for v in coal),
+            "rows_per_sec": max(v["rows_per_sec"] for v in coal),
+            "coalesce_rows_per_batch":
+                report["metrics"].get("coalesce_rows_per_batch"),
+            "padding_waste_fraction":
+                report["metrics"].get("padding_waste_fraction"),
+            "bit_identical": all(v.get("bit_identical") for v in coal),
+        }
     return report
 
 
@@ -259,6 +279,10 @@ def main():
     ap.add_argument("--depth", type=int, default=3)
     ap.add_argument("--quick", action="store_true",
                     help="small fast run (bench.py integration)")
+    ap.add_argument("--out", metavar="OUT.json", default=None,
+                    help="also write the report to this file "
+                         "(consumed by scripts/perf_probe.py --serving-results"
+                         " and scripts/check_budgets.py)")
     args = ap.parse_args()
     if args.quick:
         args.concurrency, args.requests = [16], 10
@@ -266,6 +290,12 @@ def main():
                            args.max_batch, args.batch_window_ms,
                            args.hidden, args.depth)
     print(json.dumps(report, indent=2))
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, args.out)
 
 
 if __name__ == "__main__":
