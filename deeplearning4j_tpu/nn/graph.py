@@ -809,6 +809,9 @@ class ComputationGraph:
         if isinstance(data, (DataSet, MultiDataSet)):
             _obs_metrics.install_runtime_metrics()
             ledger = _goodput.start_run("fit", net=self)
+            from deeplearning4j_tpu.observability import (
+                distributed as _obs_dist)
+            _obs_dist.stamp_run_marker("fit")
             status = "completed"
             try:
                 items = [data]
@@ -829,6 +832,8 @@ class ComputationGraph:
         _obs_metrics.install_runtime_metrics()
         tracer = _get_tracer()
         ledger = _goodput.start_run("fit", net=self)
+        from deeplearning4j_tpu.observability import distributed as _obs_dist
+        _obs_dist.stamp_run_marker("fit")
         status = "completed"
         try:
             for _ in range(epochs):

@@ -648,6 +648,8 @@ class MultiLayerNetwork:
         _obs_metrics.install_runtime_metrics()
         tracer = _get_tracer()
         ledger = _goodput.start_run("fit", net=self)
+        from deeplearning4j_tpu.observability import distributed as _obs_dist
+        _obs_dist.stamp_run_marker("fit")
         status = "completed"
         try:
             for epoch in range(epochs):

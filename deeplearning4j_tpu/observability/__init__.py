@@ -7,8 +7,11 @@ tracer, Chrome-trace/JSONL export, per-thread Perfetto lanes);
 exposition + JSON snapshot); ``goodput`` turns both into efficiency
 accounting — a per-run wall-time ledger, live MFU/goodput gauges with
 auto-derived FLOPs, padding-waste fractions, and the RunReport JSON
-artifact that scripts/check_budgets.py gates CI on. See
-OBSERVABILITY.md.
+artifact that scripts/check_budgets.py gates CI on; ``distributed``
+extends the plane across processes — stable run/instance identity,
+X-DL4J-Trace-Id propagation, metrics federation with fleet rollups and
+the health scoreboard; ``flightrec`` is the crash flight recorder
+flushed on SIGTERM/NaN/preemption/crash. See OBSERVABILITY.md.
 """
 
 from deeplearning4j_tpu.observability.trace import (  # noqa: F401
@@ -25,6 +28,15 @@ from deeplearning4j_tpu.observability.goodput import (  # noqa: F401
     EfficiencyLedger, RunReport, start_run, end_run, current_ledger,
     last_report, record_padding, live_snapshot, goodput_collector,
 )
+from deeplearning4j_tpu.observability.distributed import (  # noqa: F401
+    MetricsFederation, ProcessIdentity, TRACE_HEADER, bump_incarnation,
+    export_snapshot, get_identity, new_trace_id, push_snapshot,
+    reset_identity, set_identity, stamp_run_marker,
+)
+from deeplearning4j_tpu.observability.flightrec import (  # noqa: F401
+    FlightRecorder, get_flight_recorder, install_flight_recorder,
+    uninstall_flight_recorder,
+)
 
 __all__ = [
     "Span", "Tracer", "get_tracer", "set_tracer", "span", "trace_span",
@@ -36,4 +48,9 @@ __all__ = [
     "EfficiencyLedger", "RunReport", "start_run", "end_run",
     "current_ledger", "last_report", "record_padding", "live_snapshot",
     "goodput_collector",
+    "MetricsFederation", "ProcessIdentity", "TRACE_HEADER",
+    "bump_incarnation", "export_snapshot", "get_identity", "new_trace_id",
+    "push_snapshot", "reset_identity", "set_identity", "stamp_run_marker",
+    "FlightRecorder", "get_flight_recorder", "install_flight_recorder",
+    "uninstall_flight_recorder",
 ]

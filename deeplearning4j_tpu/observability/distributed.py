@@ -1,0 +1,468 @@
+"""Cross-process observability plane: identity, snapshot federation and
+the fleet health scoreboard.
+
+The observability core (trace.py / metrics.py / goodput.py) is strictly
+single-process: one tracer ring, one registry, one ledger. Every open
+ROADMAP direction that remains — the multi-replica serving fleet,
+elastic multi-process resilience — needs to see *across* processes. The
+TensorFlow systems papers treat cluster-wide tracing and health as a
+precondition for running fleets at all; this module is that plane:
+
+- **Identity** — every process carries a stable
+  :class:`ProcessIdentity`: a ``run_id`` shared by all members of one
+  logical run (env ``DL4J_TPU_RUN_ID``, generated otherwise), an
+  ``instance`` name unique per process (env ``DL4J_TPU_INSTANCE``,
+  default ``<host>-<pid>``) and an ``incarnation`` counter bumped on
+  every supervisor relaunch (env ``DL4J_TPU_INCARNATION`` seeds it;
+  ``chaos_train.py`` relaunches in-process, so the counter — not the
+  pid — is what tells launch 3's artifacts from launch 1's). The
+  identity is stamped onto Chrome-trace exports, RunReports, the
+  ``dl4j_instance_info`` metric family and flight-recorder artifacts.
+- **Trace propagation** — :func:`new_trace_id` mints the ids that ride
+  the ``X-DL4J-Trace-Id`` header through ``/predict`` into the
+  batcher's ``queue_wait`` / ``batch_assembly`` / ``device_compute``
+  span attrs, so one client request correlates across process
+  boundaries in a merged timeline.
+- **Federation** — :func:`export_snapshot` renders a registry into a
+  full-fidelity JSON wire form (family name/kind/help + samples with
+  the *canonical exposition-escaped key*, so the JSON side and the
+  Prometheus side can never encode a label value differently);
+  :class:`MetricsFederation` ingests pushed (or scraped) snapshots
+  from N child processes and re-exports ONE merged Prometheus view:
+  every child sample labeled with its ``instance``, plus a fleet
+  rollup sample per series (``instance="fleet"``: counters and
+  histogram buckets sum, gauges are last-write-wins by push time).
+- **Health scoreboard** — per-instance liveness/readiness derived from
+  heartbeat age (``dl4j_heartbeat_timestamp_seconds``), the pushed
+  ``healthy`` flag (the serving batcher's device-thread liveness),
+  queue depth and fit-step progress between pushes. This is the seam a
+  replica router reads to weight or evict workers.
+
+The UIServer hosts the aggregator (``POST /api/metrics_push``,
+``GET /api/fleet``, merged ``GET /metrics``); ``scripts/fleet_demo.py``
+proves the three-worker merged exposition end to end. See
+OBSERVABILITY.md "Fleet & post-mortems".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.observability.metrics import (MetricFamily,
+                                                      get_registry,
+                                                      sample_key)
+
+__all__ = [
+    "ProcessIdentity", "get_identity", "set_identity", "reset_identity",
+    "bump_incarnation", "new_trace_id", "stamp_run_marker", "TRACE_HEADER",
+    "export_snapshot", "MetricsFederation", "SNAPSHOT_SCHEMA_VERSION",
+]
+
+#: the header /predict accepts and echoes; serve_bench generates them
+TRACE_HEADER = "X-DL4J-Trace-Id"
+
+SNAPSHOT_SCHEMA_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# identity
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ProcessIdentity:
+    """Who this process is, fleet-wide. ``run_id`` groups the members of
+    one logical run; ``instance`` is unique per process; ``incarnation``
+    counts supervisor relaunches (same instance, new lifetime)."""
+
+    run_id: str
+    instance: str
+    pid: int
+    incarnation: int
+    start_time: float
+
+    @property
+    def tag(self) -> str:
+        """The fleet-unique name artifacts are keyed by: the instance,
+        suffixed with the incarnation once the process has relaunched
+        (``worker-0`` -> ``worker-0-i2``)."""
+        if self.incarnation:
+            return f"{self.instance}-i{self.incarnation}"
+        return self.instance
+
+    def labels(self) -> Dict[str, str]:
+        """The label set stamped onto ``dl4j_instance_info``."""
+        return {"run_id": self.run_id, "instance": self.instance,
+                "incarnation": str(self.incarnation), "pid": str(self.pid)}
+
+    def to_dict(self) -> dict:
+        return {"run_id": self.run_id, "instance": self.instance,
+                "pid": self.pid, "incarnation": self.incarnation,
+                "start_time": self.start_time, "tag": self.tag}
+
+
+_id_lock = threading.Lock()
+_IDENTITY: Optional[ProcessIdentity] = None
+
+
+def _build_identity() -> ProcessIdentity:
+    run_id = os.environ.get("DL4J_TPU_RUN_ID") or uuid.uuid4().hex[:12]
+    instance = os.environ.get("DL4J_TPU_INSTANCE") or (
+        f"{socket.gethostname()}-{os.getpid()}")
+    try:
+        incarnation = int(os.environ.get("DL4J_TPU_INCARNATION", "0"))
+    except ValueError:
+        incarnation = 0
+    return ProcessIdentity(run_id=run_id, instance=instance,
+                           pid=os.getpid(), incarnation=incarnation,
+                           start_time=time.time())
+
+
+def get_identity() -> ProcessIdentity:
+    """The process identity, built lazily from the ``DL4J_TPU_RUN_ID`` /
+    ``DL4J_TPU_INSTANCE`` / ``DL4J_TPU_INCARNATION`` environment on
+    first use (so a launcher exports them once and every subsystem —
+    tracer export, RunReports, metrics, flight recorder — agrees)."""
+    global _IDENTITY
+    with _id_lock:
+        if _IDENTITY is None:
+            _IDENTITY = _build_identity()
+        return _IDENTITY
+
+
+def set_identity(**fields) -> ProcessIdentity:
+    """Replace identity fields in place (``set_identity(instance="w0")``).
+    Returns the new identity."""
+    global _IDENTITY
+    with _id_lock:
+        base = _IDENTITY if _IDENTITY is not None else _build_identity()
+        d = base.to_dict()
+        d.pop("tag")
+        d.update(fields)
+        _IDENTITY = ProcessIdentity(**d)
+        return _IDENTITY
+
+
+def reset_identity() -> None:
+    """Forget the cached identity (tests: re-read the environment)."""
+    global _IDENTITY
+    with _id_lock:
+        _IDENTITY = None
+
+
+def bump_incarnation() -> ProcessIdentity:
+    """Advance the incarnation counter — called per supervisor relaunch
+    so artifacts (flight recordings, federation tags) from different
+    lifetimes of the same instance never collide, even when the
+    relaunch happens in-process with an unchanged pid."""
+    ident = get_identity()
+    return set_identity(incarnation=ident.incarnation + 1,
+                        start_time=time.time())
+
+
+def new_trace_id() -> str:
+    """Mint a trace id for the ``X-DL4J-Trace-Id`` header (16 hex chars
+    — W3C-traceparent-sized, stdlib-only)."""
+    return uuid.uuid4().hex[:16]
+
+
+def stamp_run_marker(kind: str) -> None:
+    """Record a zero-duration ``run_start`` span carrying the process
+    identity — the fit loops and servers call this at run start so any
+    exported timeline says which fleet member and incarnation it came
+    from even when sliced out of the full export."""
+    try:
+        from deeplearning4j_tpu.observability.trace import get_tracer
+        ident = get_identity()
+        t = time.perf_counter()
+        get_tracer().record("run_start", t, t, {
+            "kind": str(kind), "run_id": ident.run_id,
+            "instance": ident.instance,
+            "incarnation": ident.incarnation})
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# snapshot wire format
+# ---------------------------------------------------------------------------
+
+def export_snapshot(registry=None, health: Optional[dict] = None) -> dict:
+    """Render a registry into the federation wire form: full fidelity
+    (family kind/help, every sample's labels + suffix) plus the
+    canonical exposition-escaped ``key`` per sample, so the aggregator
+    merges and re-renders without re-deriving escaping. ``health`` is
+    the pusher's self-reported readiness payload (e.g. the serving
+    batcher's ``healthy`` flag)."""
+    reg = registry if registry is not None else get_registry()
+    fams = []
+    for fam in reg.collect():
+        fams.append({
+            "name": fam.name,
+            "kind": fam.kind,
+            "help": fam.help,
+            "samples": [
+                {"key": sample_key(fam.name, s.labels, s.suffix),
+                 "labels": dict(s.labels), "suffix": s.suffix,
+                 "value": s.value}
+                for s in fam.samples],
+        })
+    return {
+        "schema": SNAPSHOT_SCHEMA_VERSION,
+        "identity": get_identity().to_dict(),
+        "time": time.time(),
+        "families": fams,
+        "health": dict(health or {}),
+    }
+
+
+def push_snapshot(url: str, registry=None, health: Optional[dict] = None,
+                  timeout: float = 5.0) -> dict:
+    """POST :func:`export_snapshot` to an aggregator's
+    ``/api/metrics_push`` endpoint; returns the aggregator's reply."""
+    import urllib.request
+    body = json.dumps(export_snapshot(registry, health)).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+class MetricsFederation:
+    """Aggregates the latest snapshot per instance and re-exports one
+    merged Prometheus view.
+
+    Ingest is last-write-wins per instance tag (a push wholly replaces
+    that instance's previous snapshot, under one lock — concurrent
+    pushes from N worker threads/processes are safe and the merge
+    always reflects a consistent set of "latest" snapshots). Merge
+    semantics per family across instances:
+
+    - every sample re-emitted with an added ``instance=<tag>`` label
+    - one fleet rollup sample per distinct (labels, suffix) series with
+      ``instance="fleet"``: counters and histogram ``_bucket``/``_sum``
+      /``_count`` samples SUM; gauges take the value from the most
+      recently pushed snapshot that carries the series (last-write)
+    - kind conflicts keep the first-seen kind and skip the conflicting
+      family from later snapshots (a broken pusher must not corrupt the
+      merged exposition)
+    """
+
+    FLEET = "fleet"
+
+    def __init__(self, stale_after_s: float = 15.0):
+        self.stale_after_s = float(stale_after_s)
+        self._lock = threading.Lock()
+        #: tag -> {"snapshot", "received_at", "seq", "pushes",
+        #:         "steps", "steps_changed_at"}
+        self._instances: Dict[str, dict] = {}
+        self._seq = 0
+
+    # ---------------------------------------------------------------- ingest
+    def ingest(self, snapshot: dict) -> str:
+        """Accept one pushed/scraped snapshot; returns the instance tag
+        it was filed under. Raises ValueError on a malformed payload."""
+        if not isinstance(snapshot, dict) or "families" not in snapshot:
+            raise ValueError("not a metrics snapshot (no 'families')")
+        ident = snapshot.get("identity") or {}
+        tag = ident.get("tag") or ident.get("instance")
+        if not tag:
+            raise ValueError("snapshot carries no identity.tag/instance")
+        steps = _family_value(snapshot, "dl4j_fit_steps_total")
+        now = time.time()
+        with self._lock:
+            self._seq += 1
+            prev = self._instances.get(tag)
+            ent = {
+                "snapshot": snapshot,
+                "received_at": now,
+                "seq": self._seq,
+                "pushes": (prev["pushes"] + 1) if prev else 1,
+                "steps": steps,
+                "steps_changed_at": now,
+            }
+            if prev is not None and steps is not None \
+                    and steps == prev.get("steps"):
+                ent["steps_changed_at"] = prev["steps_changed_at"]
+            self._instances[tag] = ent
+        return str(tag)
+
+    def scrape(self, url: str, timeout: float = 5.0) -> str:
+        """Pull one child's ``/metrics?format=snapshot`` and ingest it
+        (the pull-mode twin of the push endpoint)."""
+        import urllib.request
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return self.ingest(json.loads(resp.read().decode()))
+
+    def drop(self, tag: str) -> None:
+        with self._lock:
+            self._instances.pop(tag, None)
+
+    def instance_tags(self) -> List[str]:
+        with self._lock:
+            return sorted(self._instances)
+
+    def instance_count(self) -> int:
+        with self._lock:
+            return len(self._instances)
+
+    # ----------------------------------------------------------------- merge
+    def merged_families(self, local: Optional[Tuple[str, list]] = None
+                        ) -> List[MetricFamily]:
+        """The merged view. ``local`` = ``(tag, families)`` folds the
+        aggregator's own registry in as one more instance (the UIServer
+        passes its own ``registry.collect()`` so the merged exposition
+        covers the whole fleet including the host process)."""
+        with self._lock:
+            instances = [(tag, ent["seq"], ent["snapshot"])
+                         for tag, ent in sorted(self._instances.items())]
+        contributions: List[Tuple[str, int, dict]] = []
+        if local is not None:
+            tag, fams = local
+            snap = {"families": [
+                {"name": f.name, "kind": f.kind, "help": f.help,
+                 "samples": [{"labels": dict(s.labels), "suffix": s.suffix,
+                              "value": s.value} for s in f.samples]}
+                for f in fams]}
+            # the local process is always the freshest writer
+            contributions.append((tag, 1 + max(
+                [seq for _, seq, _ in instances], default=0), snap))
+        contributions.extend(instances)
+
+        merged: Dict[str, MetricFamily] = {}
+        kinds: Dict[str, str] = {}
+        # series -> rollup accumulator:
+        # (family, suffix, labelkey) -> [labels, value, best_seq]
+        rollup: Dict[Tuple[str, str, str], list] = {}
+        order: List[str] = []
+        for tag, seq, snap in contributions:
+            for fdict in snap.get("families", ()):
+                name, kind = fdict.get("name"), fdict.get("kind")
+                if not name or kind not in ("counter", "gauge", "histogram"):
+                    continue
+                if name not in kinds:
+                    kinds[name] = kind
+                    merged[name] = MetricFamily(
+                        name, kind, fdict.get("help") or "")
+                    order.append(name)
+                elif kinds[name] != kind:
+                    continue  # conflicting kind: first writer wins
+                fam = merged[name]
+                for s in fdict.get("samples", ()):
+                    labels = {str(k): str(v)
+                              for k, v in (s.get("labels") or {}).items()}
+                    labels.pop("instance", None)
+                    suffix = s.get("suffix") or ""
+                    try:
+                        value = float(s.get("value"))
+                    except (TypeError, ValueError):
+                        continue
+                    fam.add(value, {**labels, "instance": tag}, suffix)
+                    rkey = (name, suffix,
+                            sample_key(name, labels, suffix))
+                    ent = rollup.get(rkey)
+                    summed = (kinds[name] == "counter"
+                              or kinds[name] == "histogram")
+                    if ent is None:
+                        rollup[rkey] = [labels, value, seq]
+                    elif summed:
+                        ent[1] += value
+                    elif seq >= ent[2]:      # gauge: last write wins
+                        ent[1], ent[2] = value, seq
+        for (name, suffix, _), (labels, value, _) in rollup.items():
+            merged[name].add(value, {**labels, "instance": self.FLEET},
+                             suffix)
+        return [merged[name] for name in order]
+
+    def render_prometheus(self, local: Optional[Tuple[str, list]] = None
+                          ) -> str:
+        fams = self.merged_families(local)
+        if not fams:
+            return "\n"
+        return "\n".join(f.render() for f in fams) + "\n"
+
+    # ---------------------------------------------------------------- health
+    def health(self) -> List[dict]:
+        """The scoreboard: one dict per instance with liveness (heartbeat
+        + push age vs ``stale_after_s``), readiness (the pushed
+        ``healthy`` flags, e.g. the serving batcher's device-thread
+        liveness), queue depth, step count and progress age."""
+        now = time.time()
+        with self._lock:
+            items = sorted(self._instances.items())
+        out = []
+        for tag, ent in items:
+            snap = ent["snapshot"]
+            push_age = max(0.0, now - ent["received_at"])
+            hb = _family_value(snap, "dl4j_heartbeat_timestamp_seconds")
+            snap_time = snap.get("time")
+            # heartbeat age = staleness at push time (child clock) plus
+            # how long ago the push landed (aggregator clock) — robust
+            # to small cross-host clock skew
+            hb_age = push_age
+            if hb is not None and snap_time is not None:
+                hb_age += max(0.0, float(snap_time) - float(hb))
+            health_payload = snap.get("health") or {}
+            flags = [bool(v) for k, v in health_payload.items()
+                     if k.endswith("healthy") or k == "ready"]
+            live = hb_age <= self.stale_after_s
+            steps = ent.get("steps")
+            row = {
+                "instance": tag,
+                "identity": snap.get("identity") or {},
+                "live": live,
+                "ready": live and all(flags) if flags else live,
+                "heartbeat_age_s": round(hb_age, 3),
+                "push_age_s": round(push_age, 3),
+                "pushes": ent["pushes"],
+                "queue_depth": _family_value(
+                    snap, "dl4j_serving_queue_depth", agg=sum),
+                "steps_total": steps,
+                "last_progress_age_s": (
+                    round(max(0.0, now - ent["steps_changed_at"]), 3)
+                    if steps is not None else None),
+                "health": health_payload,
+            }
+            out.append(row)
+        return out
+
+    def fleet_payload(self) -> dict:
+        """The ``/api/fleet`` JSON: scoreboard + aggregate counts."""
+        rows = self.health()
+        return {
+            "time": time.time(),
+            "instances": rows,
+            "live": sum(1 for r in rows if r["live"]),
+            "ready": sum(1 for r in rows if r["ready"]),
+            "stale_after_s": self.stale_after_s,
+        }
+
+
+def _family_value(snapshot: dict, name: str, agg=None) -> Optional[float]:
+    """Pull one family's scalar out of a wire snapshot (sum of its plain
+    samples by default — per-label children of a counter/gauge)."""
+    for fdict in snapshot.get("families", ()):
+        if fdict.get("name") != name:
+            continue
+        vals = []
+        for s in fdict.get("samples", ()):
+            if s.get("suffix"):
+                continue
+            try:
+                vals.append(float(s.get("value")))
+            except (TypeError, ValueError):
+                continue
+        if not vals:
+            return None
+        return float((agg or sum)(vals)) if len(vals) > 1 else vals[0]
+    return None

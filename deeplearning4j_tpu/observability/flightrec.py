@@ -1,0 +1,193 @@
+"""Crash flight recorder: a black-box ring flushed on the way down.
+
+Post-mortems for the resilience tier currently depend on whatever the
+process managed to log before dying. The flight recorder keeps a small
+always-on ring of the most recent spans (fed straight off the tracer's
+sink seam, so it sees exactly what the tracer saw, including sampled-in
+spans only) plus recent supervisor events (recovery, NaN rollback,
+preemption, checkpoint activity) and, at flush time, a full metrics
+snapshot. On SIGTERM, unhandled exception, NaN rollback or preemption
+the ring is flushed atomically (tmp + ``os.replace``) to
+``flight_<tag>.json`` — ``tag`` being the instance name suffixed with
+the supervisor incarnation, so every relaunch of ``chaos_train.py``
+leaves its own readable artifact instead of overwriting the last one.
+
+The recorder is deliberately cheap on the hot path: recording a span is
+one deque append under the tracer's existing sink call; recording an
+event is one deque append under its own lock; everything expensive
+(metrics snapshot, JSON encode, file IO) happens only at flush. The
+``identity_overhead`` bench in ``bench.py`` holds the installed-vs-not
+fit-time delta under 1%.
+
+Schema (``"schema": 1``) is documented with an example in
+OBSERVABILITY.md "Fleet & post-mortems".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback as _tb
+from collections import deque
+from typing import Optional
+
+from deeplearning4j_tpu.observability import trace as _trace
+from deeplearning4j_tpu.observability.distributed import get_identity
+
+__all__ = [
+    "FlightRecorder", "get_flight_recorder", "install_flight_recorder",
+    "uninstall_flight_recorder",
+]
+
+FLIGHT_SCHEMA_VERSION = 1
+
+
+def _sanitize(tag: str) -> str:
+    return "".join(c if (c.isalnum() or c in "-_.") else "_" for c in tag)
+
+
+class FlightRecorder:
+    """Bounded black-box ring of spans + events, flushed atomically to
+    ``flight_<tag>.json`` when something goes wrong."""
+
+    def __init__(self, dir: Optional[str] = None, capacity: int = 256,
+                 event_capacity: int = 128):
+        self.dir = (dir or os.environ.get("DL4J_TPU_FLIGHT_DIR")
+                    or os.getcwd())
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._spans = deque(maxlen=int(capacity))
+        self._events = deque(maxlen=int(event_capacity))
+        self._installed = False
+        self._prev_excepthook = None
+        self._flushes = 0
+        #: path of the most recent artifact (None until first flush)
+        self.last_path: Optional[str] = None
+
+    # ------------------------------------------------------------- recording
+    def _sink(self, span) -> None:
+        # called by the tracer outside its lock, per recorded span
+        with self._lock:
+            self._spans.append(span)
+
+    def record_event(self, kind: str, step: Optional[int] = None,
+                     detail: str = "") -> None:
+        """Append one supervisor/runtime event (recovery, nan_rollback,
+        preemption, checkpoint, ...) to the event ring."""
+        with self._lock:
+            self._events.append({"time": time.time(), "kind": str(kind),
+                                 "step": step, "detail": str(detail)})
+
+    # ----------------------------------------------------------- lifecycle
+    def install(self) -> "FlightRecorder":
+        """Attach to the current tracer's sink seam and chain into
+        ``sys.excepthook`` so a crash flushes the box. Idempotent."""
+        if self._installed:
+            return self
+        _trace.get_tracer().add_sink(self._sink)
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        try:
+            _trace.get_tracer().remove_sink(self._sink)
+        except Exception:
+            pass
+        if sys.excepthook is self._excepthook:
+            sys.excepthook = self._prev_excepthook or sys.__excepthook__
+        self._prev_excepthook = None
+        self._installed = False
+
+    def _excepthook(self, exc_type, exc, tb):
+        try:
+            self.flush("unhandled_exception", exc=exc)
+        except Exception:
+            pass
+        (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    # --------------------------------------------------------------- flush
+    def flush(self, reason: str, exc: Optional[BaseException] = None
+              ) -> Optional[str]:
+        """Write the black box to ``flight_<tag>.json`` atomically;
+        returns the path (None if the write failed — a flight recorder
+        must never turn a crash into a different crash)."""
+        ident = get_identity()
+        with self._lock:
+            spans = list(self._spans)
+            events = list(self._events)
+            self._flushes += 1
+        doc = {
+            "schema": FLIGHT_SCHEMA_VERSION,
+            "reason": str(reason),
+            "time": time.time(),
+            "identity": ident.to_dict(),
+            "exception": None,
+            "events": events,
+            "spans": [
+                {"name": s.name, "ts_us": s.ts_us, "dur_us": s.dur_us,
+                 "thread": s.thread, "attrs": dict(s.attrs or {})}
+                for s in spans],
+            "metrics": None,
+        }
+        if exc is not None:
+            doc["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": "".join(_tb.format_exception(
+                    type(exc), exc, exc.__traceback__))[-8000:],
+            }
+        try:
+            from deeplearning4j_tpu.observability.metrics import get_registry
+            doc["metrics"] = get_registry().snapshot()
+        except Exception:
+            pass
+        path = os.path.join(self.dir, f"flight_{_sanitize(ident.tag)}.json")
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        self.last_path = path
+        return path
+
+
+_rec_lock = threading.Lock()
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    """The installed process-wide recorder, or None."""
+    return _RECORDER
+
+
+def install_flight_recorder(dir: Optional[str] = None,
+                            capacity: int = 256) -> FlightRecorder:
+    """Create-or-reuse the process-wide recorder and install it. A
+    second call just repoints the flush directory (the supervisor calls
+    this per launch with its checkpoint dir)."""
+    global _RECORDER
+    with _rec_lock:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder(dir=dir, capacity=capacity)
+        elif dir is not None:
+            _RECORDER.dir = dir
+        return _RECORDER.install()
+
+
+def uninstall_flight_recorder() -> None:
+    """Detach and forget the process-wide recorder (tests, benches)."""
+    global _RECORDER
+    with _rec_lock:
+        if _RECORDER is not None:
+            _RECORDER.uninstall()
+            _RECORDER = None

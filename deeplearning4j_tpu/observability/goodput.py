@@ -159,6 +159,11 @@ class RunReport:
     device_memory_peak_bytes: Optional[float] = None
     padding: Dict[str, dict] = field(default_factory=dict)
     trace_dropped_spans: int = 0
+    # fleet identity (observability.distributed): which process/relaunch
+    # produced this report — stamped by the ledger at finish time
+    run_id: Optional[str] = None
+    instance: Optional[str] = None
+    incarnation: Optional[int] = None
     schema_version: int = SCHEMA_VERSION
 
     def to_dict(self) -> dict:
@@ -182,6 +187,9 @@ class RunReport:
             "device_memory_peak_bytes": self.device_memory_peak_bytes,
             "padding": self.padding,
             "trace_dropped_spans": self.trace_dropped_spans,
+            "run_id": self.run_id,
+            "instance": self.instance,
+            "incarnation": self.incarnation,
         }
 
     @classmethod
@@ -332,7 +340,16 @@ class EfficiencyLedger:
             dropped = max(0, tracer.dropped - self._dropped0)
         peak = resolve_peak_flops()
         fps = live["flops_per_second"]
+        try:
+            from deeplearning4j_tpu.observability.distributed import \
+                get_identity
+            ident = get_identity()
+            identity = {"run_id": ident.run_id, "instance": ident.instance,
+                        "incarnation": ident.incarnation}
+        except Exception:
+            identity = {}
         return RunReport(
+            **identity,
             kind=self.kind,
             status=status,
             wall_s=wall,

@@ -30,7 +30,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
-    "Sample", "get_registry", "set_registry",
+    "Sample", "get_registry", "set_registry", "sample_key",
     "install_runtime_metrics", "observe_step", "observe_dispatch_lag",
     "wants_prometheus", "PROMETHEUS_CONTENT_TYPE",
 ]
@@ -62,6 +62,21 @@ def _escape_label_value(v: str) -> str:
 
 def _escape_help(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def sample_key(name: str, labels: Optional[Dict[str, str]] = None,
+               suffix: str = "") -> str:
+    """The canonical identity of one sample: exactly the series string
+    the exposition format renders (`name{k="escaped"}`), labels sorted,
+    values exposition-escaped. Both the Prometheus renderer and the
+    federation JSON wire format key samples by this, so a label value
+    containing `"` or a newline can never be encoded two different ways
+    on the two paths."""
+    if not labels:
+        return f"{name}{suffix}"
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{suffix}{{{inner}}}"
 
 
 def _fmt_value(v: float) -> str:
@@ -118,14 +133,8 @@ class MetricFamily:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
         for s in self.samples:
-            if s.labels:
-                inner = ",".join(
-                    f'{k}="{_escape_label_value(v)}"'
-                    for k, v in sorted(s.labels.items()))
-                lines.append(
-                    f"{self.name}{s.suffix}{{{inner}}} {_fmt_value(s.value)}")
-            else:
-                lines.append(f"{self.name}{s.suffix} {_fmt_value(s.value)}")
+            lines.append(f"{sample_key(self.name, s.labels, s.suffix)} "
+                         f"{_fmt_value(s.value)}")
         return "\n".join(lines)
 
     def to_json(self):
@@ -400,6 +409,10 @@ def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
 # registered once per process; jax.monitoring has no unregister API.
 
 _runtime_lock = threading.Lock()
+# Stamped at module import — the standard Prometheus process-identity
+# anchor; the federation's health scoreboard keys heartbeat age off the
+# companion dl4j_heartbeat_timestamp_seconds rendered per scrape.
+_PROCESS_START_TIME = time.time()
 _COMPILE = {"count": 0, "seconds": 0.0}
 _COMPILE_LISTENER_ON = False
 _RUNTIME_INSTALLED_ON: Optional[MetricsRegistry] = None
@@ -452,7 +465,23 @@ def _runtime_collector() -> List[MetricFamily]:
                      "Last observed host->device dispatch lag (time the "
                      "host waited on device results at a sync point)"
                      ).add(steps["dispatch_lag_s"]),
+        MetricFamily("dl4j_process_start_time_seconds", "gauge",
+                     "Unix time the observability runtime was imported "
+                     "(standard process-identity family)"
+                     ).add(_PROCESS_START_TIME),
+        MetricFamily("dl4j_heartbeat_timestamp_seconds", "gauge",
+                     "Unix time of this render — liveness heartbeat; the "
+                     "fleet scoreboard derives heartbeat age from it"
+                     ).add(time.time()),
     ]
+    try:
+        from deeplearning4j_tpu.observability.distributed import get_identity
+        fams.append(MetricFamily(
+            "dl4j_instance_info", "gauge",
+            "Process identity as labels (run_id/instance/incarnation/"
+            "pid); always 1").add(1.0, get_identity().labels()))
+    except Exception:
+        pass
     mem = MetricFamily(
         "dl4j_device_memory_bytes", "gauge",
         "Per-device memory from jax.local_devices()[i].memory_stats(); "

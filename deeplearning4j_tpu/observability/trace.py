@@ -268,6 +268,16 @@ class Tracer:
                 "dropped_spans_total": self.dropped,
                 "dropped_spans_by_name": dropped,
             }
+        try:
+            # identity rides in otherData (NOT a metadata event — lanes
+            # stay thread_name-only) so exports from different fleet
+            # members can be attributed and merged after the fact
+            from deeplearning4j_tpu.observability.distributed import \
+                get_identity
+            out.setdefault("otherData", {})["identity"] = \
+                get_identity().to_dict()
+        except Exception:
+            pass
         return out
 
     def export_chrome_trace(self, path: str) -> str:

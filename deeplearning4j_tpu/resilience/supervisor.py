@@ -196,6 +196,11 @@ class SupervisorConfig:
     #: previous valid checkpoint restorable.
     async_checkpoints: bool = True
     handle_sigterm: bool = True
+    #: keep a crash flight recorder (observability.flightrec) installed
+    #: for the run: recent spans + recovery events, flushed atomically
+    #: to flight_<instance>.json in checkpoint_dir on SIGTERM, NaN
+    #: rollback, preemption and crash
+    flight_recorder: bool = True
     #: injectable for tests (real runs sleep through backoff)
     sleep_fn: Callable[[float], None] = time.sleep
 
@@ -242,6 +247,26 @@ class TrainingSupervisor:
         #: (step, lazy device score) pairs not yet NaN-checked
         self._pending_scores: List[tuple] = []
         os.makedirs(config.checkpoint_dir, exist_ok=True)
+        #: crash flight recorder (black box): best-effort — its absence
+        #: must never break training
+        self.flight = None
+        if config.flight_recorder:
+            try:
+                from deeplearning4j_tpu.observability.flightrec import \
+                    install_flight_recorder
+                self.flight = install_flight_recorder(
+                    dir=config.checkpoint_dir)
+            except Exception:
+                self.flight = None
+
+    def _flight_flush(self, reason: str, exc=None) -> Optional[str]:
+        """Flush the black box (best-effort; returns the artifact path)."""
+        if self.flight is None:
+            return None
+        try:
+            return self.flight.flush(reason, exc=exc)
+        except Exception:
+            return None
 
     # --------------------------------------------------------------- events
     def _emit(self, kind: str, step: int, detail: str = "",
@@ -250,6 +275,11 @@ class TrainingSupervisor:
         self.events.append(ev)
         if counter:
             self.stats.bump(counter)
+        if self.flight is not None:
+            try:  # the black box sees every recovery event
+                self.flight.record_event(kind, step, detail)
+            except Exception:
+                pass
         logger.info("resilience %s", ev)
         for l in getattr(self.net, "listeners", ()):
             on_recovery = getattr(l, "on_recovery", None)
@@ -426,6 +456,9 @@ class TrainingSupervisor:
     def _sigterm(self, signum, frame):
         logger.warning("SIGTERM received — will checkpoint and exit at "
                        "the next step boundary")
+        # flush the black box NOW: if the sender escalates to SIGKILL
+        # before the clean boundary, the post-mortem already exists
+        self._flight_flush("sigterm")
         self.request_preemption()
 
     def _attempt_step(self, ds, step: int):
@@ -492,6 +525,7 @@ class TrainingSupervisor:
                    f"non-finite loss ({score}) at step {step}; restored "
                    f"{self._last_good}, lr scale now {new_scale:g}",
                    counter="rollbacks")
+        self._flight_flush("nan_rollback")
 
     # ------------------------------------------------------------ main loop
     def run(self, batch_fn: Callable[[int], object],
@@ -583,6 +617,7 @@ class TrainingSupervisor:
                 self._emit("preempt", net.iteration,
                            f"clean exit at step {net.iteration} of "
                            f"{target_step}", counter="preemptions")
+                self._flight_flush("preemption")
             else:
                 self._drain_checkpoint()  # settle _last_good first
                 if self._last_good != self._step_dir(net.iteration):
@@ -598,6 +633,8 @@ class TrainingSupervisor:
             if sys.exc_info()[0] is not None:
                 # exception path: still close the ledger (end_run is
                 # idempotent, so the clean-path call below stays a no-op)
+                # and flush the black box — THE post-mortem artifact
+                self._flight_flush("exception", exc=sys.exc_info()[1])
                 _goodput.end_run(ledger, status="failed")
 
         report = _goodput.end_run(
@@ -718,6 +755,7 @@ class TrainingSupervisor:
                            f"clean exit at step {net.iteration} (datapipe "
                            f"epoch {pipeline.epoch} of {epochs})",
                            counter="preemptions")
+                self._flight_flush("preemption")
             else:
                 self._drain_checkpoint()  # settle _last_good first
                 if self._last_good != self._step_dir(net.iteration):
@@ -732,6 +770,7 @@ class TrainingSupervisor:
             pipeline.stats.detach_from_registry()
             self._drain_checkpoint(raise_errors=False)
             if sys.exc_info()[0] is not None:
+                self._flight_flush("exception", exc=sys.exc_info()[1])
                 _goodput.end_run(ledger, status="failed")
 
         report = _goodput.end_run(

@@ -81,14 +81,25 @@ def next_bucket(n: int, max_batch: int, min_batch: int = 1) -> int:
 
 
 class _Ticket:
-    __slots__ = ("feats", "rows", "key", "future", "t_submit")
+    __slots__ = ("feats", "rows", "key", "future", "t_submit", "trace_id")
 
-    def __init__(self, feats, rows, key):
+    def __init__(self, feats, rows, key, trace_id=None):
         self.feats = feats
         self.rows = rows
         self.key = key
         self.future = Future()
         self.t_submit = time.perf_counter()
+        self.trace_id = trace_id
+
+
+def _trace_ids(batch) -> list:
+    """The distinct client trace ids riding a coalesced batch (ordered,
+    deduped) — the correlation key a merged fleet timeline joins on."""
+    out = []
+    for t in batch:
+        if t.trace_id and t.trace_id not in out:
+            out.append(t.trace_id)
+    return out
 
 
 class MicroBatcher:
@@ -171,16 +182,19 @@ class MicroBatcher:
             self._thread = None
 
     # --------------------------------------------------------------- enqueue
-    def submit(self, feats: list) -> Future:
+    def submit(self, feats: list, trace_id: str = None) -> Future:
         """Enqueue one request (``feats``: list of arrays, one per model
         input, equal leading row counts <= max_batch). Returns a Future
-        resolving to the model output sliced back to this ticket's rows."""
+        resolving to the model output sliced back to this ticket's rows.
+        ``trace_id`` (the client's ``X-DL4J-Trace-Id``) rides the ticket
+        onto the queue_wait/batch_assembly/device_compute span attrs so
+        server spans correlate with client-side spans."""
         rows = int(feats[0].shape[0])
         if rows > self.max_batch:
             raise ValueError(f"ticket of {rows} rows > max_batch "
                              f"{self.max_batch} — chunk before submit")
         key = tuple(tuple(f.shape[1:]) for f in feats)
-        t = _Ticket(feats, rows, key)
+        t = _Ticket(feats, rows, key, trace_id)
         with self._cond:
             if not self.healthy:
                 raise BatcherDeadError("device thread is dead")
@@ -242,9 +256,12 @@ class MicroBatcher:
                     batch, rows = self._gather_locked()
                 # one queue_wait span per device forward, timed from the
                 # oldest ticket's submit (the worst wait in the batch)
+                attrs = {"tickets": len(batch)}
+                tids = _trace_ids(batch)
+                if tids:
+                    attrs["trace_ids"] = tids
                 _get_tracer().record("queue_wait", batch[0].t_submit,
-                                     time.perf_counter(),
-                                     {"tickets": len(batch)})
+                                     time.perf_counter(), attrs)
                 self._execute(batch, rows)
                 batch = None
         except BaseException as e:  # noqa: BLE001 — device thread death
@@ -271,8 +288,11 @@ class MicroBatcher:
     def _execute(self, batch, rows):
         n_inputs = len(batch[0].feats)
         tracer = _get_tracer()
+        tids = _trace_ids(batch)
+        tid_attrs = {"trace_ids": tids} if tids else {}
         try:
-            with tracer.span("batch_assembly", tickets=len(batch)):
+            with tracer.span("batch_assembly", tickets=len(batch),
+                             **tid_attrs):
                 feats = [np.concatenate([t.feats[i] for t in batch])
                          if len(batch) > 1 else batch[0].feats[i]
                          for i in range(n_inputs)]
@@ -281,7 +301,8 @@ class MicroBatcher:
                     feats = [np.pad(f, [(0, bucket - rows)] + [(0, 0)]
                                     * (f.ndim - 1)) for f in feats]
                 self.shapes_seen.add(bucket)
-            with tracer.span("device_compute", bucket=bucket, rows=rows):
+            with tracer.span("device_compute", bucket=bucket, rows=rows,
+                             **tid_attrs):
                 out = self._forward(feats)
         except Exception as e:
             for t in batch:

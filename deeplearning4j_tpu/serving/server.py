@@ -192,14 +192,16 @@ class ModelServer:
         return None if s is None else [s]
 
     # ------------------------------------------------------------ inference
-    def predict(self, features):
+    def predict(self, features, trace_id=None):
         """Enqueue the request into the micro-batcher and wait for the
         scattered result rows. Requests larger than ``max_batch`` are
         split into ``max_batch`` chunks so they reuse the already-compiled
         full-bucket program instead of compiling a fresh XLA executable of
         arbitrary shape. ``features``: one array (sequential net) or list
-        of arrays (graph). Raises QueueFullError when admission control
-        rejects (mapped to HTTP 503)."""
+        of arrays (graph). ``trace_id`` propagates onto the batcher span
+        attrs (the HTTP handler passes the client's ``X-DL4J-Trace-Id``).
+        Raises QueueFullError when admission control rejects (mapped to
+        HTTP 503)."""
         t0 = time.perf_counter()
         many = isinstance(features, (list, tuple))
         if many and not self._is_graph and len(features) != 1:
@@ -214,7 +216,8 @@ class ModelServer:
             raise ValueError("all inputs must have the same number of rows")
         self._batcher.start()  # idempotent; lazy for direct predict() use
         futures = [self._batcher.submit(
-                       [f[i:i + self.max_batch] for f in feats])
+                       [f[i:i + self.max_batch] for f in feats],
+                       trace_id=trace_id)
                    for i in range(0, max(n, 1), self.max_batch)]
         # one deadline for the whole request, not per chunk: the budget
         # left after chunk k is what chunk k+1 may spend
@@ -299,7 +302,15 @@ class ModelServer:
                                 "params": int(server.net.num_params()),
                                 "graph": server._is_graph})
                 elif self.path.startswith("/metrics"):
-                    if _obs_metrics.wants_prometheus(
+                    if "format=snapshot" in self.path:
+                        # federation wire form: full-fidelity families +
+                        # identity + health, for an aggregator's scrape
+                        from deeplearning4j_tpu.observability import \
+                            distributed as _dist
+                        self._json(_dist.export_snapshot(
+                            health={"batcher_healthy":
+                                    server._batcher.healthy}))
+                    elif _obs_metrics.wants_prometheus(
                             self.headers.get("Accept", ""), self.path):
                         # the full unified registry (serving + resilience
                         # + compile + device-memory series), not just the
@@ -315,31 +326,43 @@ class ModelServer:
                 if not self.path.startswith("/predict"):
                     self._json({"error": "not found"}, 404)
                     return
+                # trace-context propagation: accept the client's id (or
+                # mint one) so batcher spans carry it, and echo it back
+                # so the client can stitch both timelines together
+                from deeplearning4j_tpu.observability import \
+                    distributed as _dist
+                trace_id = (self.headers.get(_dist.TRACE_HEADER)
+                            or _dist.new_trace_id())
+                echo = ((_dist.TRACE_HEADER, trace_id),)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n).decode())
                     if "inputs" in payload:
                         out = server.predict([np.asarray(a) for a in
-                                              payload["inputs"]])
+                                              payload["inputs"]],
+                                             trace_id=trace_id)
                     else:
-                        out = server.predict(np.asarray(payload["features"]))
+                        out = server.predict(np.asarray(payload["features"]),
+                                             trace_id=trace_id)
                     if isinstance(out, list):
                         preds = [np.asarray(o).tolist() for o in out]
                     else:
                         preds = np.asarray(out).tolist()
-                    self._json({"predictions": preds})
+                    self._json({"predictions": preds}, headers=echo)
                 except QueueFullError as e:
                     # backpressure: shed load instead of growing the queue
                     self._json({"error": f"overloaded: {e}"}, 503,
-                               headers=(("Retry-After", "1"),))
+                               headers=(("Retry-After", "1"),) + echo)
                 except BatcherDeadError as e:
                     # dead device thread: same 503 the health check gives
-                    self._json({"error": f"unhealthy: {e}"}, 503)
+                    self._json({"error": f"unhealthy: {e}"}, 503,
+                               headers=echo)
                 except DeadlineExceededError as e:
-                    self._json({"error": str(e)}, 504)
+                    self._json({"error": str(e)}, 504, headers=echo)
                 except Exception as e:  # surface as a 400, keep serving
                     server.stats.record_error()
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400,
+                               headers=echo)
 
         self._httpd = _ServingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -349,6 +372,8 @@ class ModelServer:
                     "compute_dtype": self.serving_compute_dtype},
             shapes_fn=lambda: self.shapes_seen)
         self._ledger = _goodput.start_run("serving", net=self.net)
+        from deeplearning4j_tpu.observability import distributed as _dist
+        _dist.stamp_run_marker("serving")
         import threading
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

@@ -96,6 +96,12 @@ select{margin-left:12px}
     style="height:34px"></svg><div id="goodputlegend" class="label"></div>
  </div>
 </div>
+<div class="row">
+ <div class="card" id="fleetcard" style="display:none">
+   <h3>Fleet health <span id="fleetsummary" class="label"></span></h3>
+   <div id="fleettable"></div>
+ </div>
+</div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -139,6 +145,7 @@ function workerSeries(u, field){
            color:COLORS[field==="scores"?0:1]}];
 }
 async function refresh(){
+  await refreshFleet();   // fleet scoreboard lives without any session
   const sess = document.getElementById("session").value;
   if (!sess) return;
   const u = await (await fetch("/api/updates?session="+
@@ -229,6 +236,32 @@ async function refreshGoodput(){
     names.map(n=>`<span style="color:${spanColor(n)}">&#9632; `+
       `${esc(n)} ${phases[n].seconds.toFixed(2)}s</span>`).join(" &nbsp;")+
     ' <span style="color:#999">&#9632; untracked</span>';
+}
+async function refreshFleet(){
+  // /api/fleet health scoreboard: one row per pushing instance —
+  // liveness from heartbeat age, readiness from the pushed health
+  // flags, queue depth + fit-step progress for routing decisions
+  const f = await (await fetch("/api/fleet")).json();
+  const card = document.getElementById("fleetcard");
+  const rows = f.instances || [];
+  if (!rows.length){ card.style.display = "none"; return; }
+  card.style.display = "";
+  document.getElementById("fleetsummary").textContent =
+    `(${f.ready}/${rows.length} ready, stale after ${f.stale_after_s}s)`;
+  const dot = ok => `<span style="color:${ok?'#188038':'#d93025'}">`+
+    `${ok?'&#9679;':'&#9675;'}</span>`;
+  let html = "<table><tr><th>instance</th><th>live</th><th>ready</th>"+
+    "<th>heartbeat age s</th><th>queue</th><th>steps</th>"+
+    "<th>progress age s</th><th>pushes</th></tr>";
+  rows.forEach(r=>{
+    html += `<tr><td>${esc(r.instance)}</td><td>${dot(r.live)}</td>`+
+      `<td>${dot(r.ready)}</td><td>${r.heartbeat_age_s}</td>`+
+      `<td>${r.queue_depth ?? "—"}</td>`+
+      `<td>${r.steps_total ?? "—"}</td>`+
+      `<td>${r.last_progress_age_s ?? "—"}</td>`+
+      `<td>${r.pushes}</td></tr>`;
+  });
+  document.getElementById("fleettable").innerHTML = html + "</table>";
 }
 const TRACE_PALETTE=["#1f77b4","#ff7f0e","#2ca02c","#d93025","#9334e6",
   "#8c564b","#e377c2","#7f7f7f","#bcbd22","#12858d"];
@@ -514,12 +547,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(ui.phases_payload(q.get("session", "")))
         elif url.path == "/metrics":
             from deeplearning4j_tpu.observability import metrics as om
-            if om.wants_prometheus(self.headers.get("Accept", ""),
-                                   url.query):
-                self._send(om.get_registry().render_prometheus().encode(),
-                           om.PROMETHEUS_CONTENT_TYPE)
+            if "format=snapshot" in url.query:
+                from deeplearning4j_tpu.observability import (
+                    distributed as dist)
+                self._json(dist.export_snapshot())
+            elif om.wants_prometheus(self.headers.get("Accept", ""),
+                                     url.query):
+                if ui.federation.instance_count():
+                    # fleet members have pushed: render the merged view
+                    # (this process folded in as one more instance)
+                    from deeplearning4j_tpu.observability import (
+                        distributed as dist)
+                    body = ui.federation.render_prometheus(
+                        local=(dist.get_identity().tag,
+                               om.get_registry().collect()))
+                else:
+                    body = om.get_registry().render_prometheus()
+                self._send(body.encode(), om.PROMETHEUS_CONTENT_TYPE)
             else:
                 self._json(om.get_registry().snapshot())
+        elif url.path == "/api/fleet":
+            self._json(ui.federation.fleet_payload())
         elif url.path == "/api/trace":
             from deeplearning4j_tpu.observability.trace import get_tracer
             self._json(get_tracer().to_chrome_trace())
@@ -531,16 +579,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 (stdlib API)
         # remote stats receiver (the reference UI's remote module:
-        # workers post through a StatsStorageRouter — ui/router.py)
+        # workers post through a StatsStorageRouter — ui/router.py) plus
+        # the metrics-federation push endpoint
         ui: "UIServer" = self.server.ui_server  # type: ignore[attr-defined]
-        if urlparse(self.path).path != "/api/post":
+        path = urlparse(self.path).path
+        if path not in ("/api/post", "/api/metrics_push"):
             self._json({"error": "not found"}, 404)
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n).decode())
-            ui.receive_post(payload)
-            self._json({"status": "ok"})
+            if path == "/api/metrics_push":
+                tag = ui.federation.ingest(payload)
+                self._json({"status": "ok", "instance": tag,
+                            "instances": ui.federation.instance_count()})
+            else:
+                ui.receive_post(payload)
+                self._json({"status": "ok"})
         except Exception as e:  # malformed post must not kill the server
             self._json({"error": f"{type(e).__name__}: {e}"}, 400)
 
@@ -561,6 +616,12 @@ class UIServer:
         from deeplearning4j_tpu.observability.metrics import (
             install_runtime_metrics)
         install_runtime_metrics()
+        # fleet aggregator: child processes push export_snapshot() to
+        # /api/metrics_push; /metrics re-exports the merged view and
+        # /api/fleet serves the health scoreboard
+        from deeplearning4j_tpu.observability.distributed import (
+            MetricsFederation)
+        self.federation = MetricsFederation()
         self.port = self._httpd.server_address[1]  # resolved if port=0
         self.host = host
         self._thread = threading.Thread(

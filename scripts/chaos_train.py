@@ -35,6 +35,7 @@ pytest variant of this loop is
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shutil
 import sys
@@ -79,6 +80,27 @@ def build_batches(seed, batch_size, n_batches=4):
 def flat_params(net):
     return {(n, k): np.asarray(v) for n, sub in net.params.items()
             for k, v in sub.items()}
+
+
+def verify_flight(launch, expect_reason=None):
+    """Every injected fault must leave a readable post-mortem: assert
+    the flight-recorder artifact for this launch exists and parses,
+    print its path, return the parsed doc."""
+    from deeplearning4j_tpu.observability.flightrec import (
+        get_flight_recorder)
+    rec = get_flight_recorder()
+    path = rec.last_path if rec is not None else None
+    assert path and os.path.exists(path), (
+        f"launch {launch}: no flight-recorder artifact was flushed")
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc.get("schema") == 1 and doc.get("identity"), doc.keys()
+    if expect_reason is not None:
+        assert doc["reason"] == expect_reason, (doc["reason"], expect_reason)
+    print(f"[flight] launch {launch}: '{doc['reason']}' post-mortem -> "
+          f"{path}  ({len(doc['events'])} events, {len(doc['spans'])} "
+          f"spans, incarnation {doc['identity']['incarnation']})")
+    return doc
 
 
 def chaos_schedule(steps):
@@ -133,10 +155,21 @@ def main():
     n_faults = sum(len(launch) for launch in schedule)
     print(f"\n[chaos] target step {args.steps}, checkpoint every "
           f"{args.checkpoint_every}, dir {ckpt_dir}")
+    from deeplearning4j_tpu.observability.distributed import (
+        bump_incarnation, get_identity)
+
     launches, net, result = 0, None, None
     totals = {}
     while True:
         launches += 1
+        # each relaunch is a new incarnation of the same instance: the
+        # flight-recorder artifact and federation tag for launch N must
+        # not collide with launch N-1's (the relaunch is in-process, so
+        # the pid alone cannot tell them apart)
+        if launches > 1:
+            bump_incarnation()
+        print(f"[chaos] launch {launches}: identity "
+              f"{get_identity().tag}")
         injector = FaultInjector()
         for fault, at in schedule[min(launches - 1, len(schedule) - 1)]:
             if fault == "crash_save":
@@ -161,6 +194,7 @@ def main():
         except InjectedCrash as e:
             print(f"[chaos] launch {launches}: KILLED mid-save ({e}) at "
                   f"step {net.iteration} — relaunching")
+            verify_flight(launches, expect_reason="exception")
             for k, v in sup.stats.snapshot().items():
                 totals[k] = totals.get(k, 0) + v
             continue
@@ -169,6 +203,7 @@ def main():
         if result.status == "preempted":
             print(f"[chaos] launch {launches}: preempted cleanly at step "
                   f"{result.final_step} — relaunching")
+            verify_flight(launches, expect_reason="preemption")
             continue
         print(f"[chaos] launch {launches}: completed at step "
               f"{result.final_step}"

@@ -19,11 +19,18 @@ Keys starting with "_" are comments. Derived fields available beyond
 the raw RunReport keys: ``untracked_fraction``, ``attributed_fraction``
 (attributed_s / wall_s) and ``padding_waste_fraction`` (worst source).
 
+``--fleet`` gates a fleet snapshot (the UIServer's ``/api/fleet``
+payload, or ``scripts/fleet_demo.py --out``) against the "fleet"
+section: every ``min_``/``max_`` bound is evaluated PER INSTANCE (e.g.
+``max_heartbeat_age_s`` fails if ANY member's heartbeat is stale), plus
+``min_live`` / ``min_ready`` over the rollup counts.
+
 Usage:
     python scripts/check_budgets.py --report run_report.json
     python scripts/check_budgets.py --report rr.json --section fit
     python scripts/check_budgets.py --bench goodput_overhead.json
     python scripts/check_budgets.py --report rr.json --budgets MY.json
+    python scripts/check_budgets.py --fleet fleet.json
 
 Exit status 0 = all budgets hold, 1 = at least one violated (each
 violation printed on its own line), 2 = usage / unreadable input.
@@ -92,6 +99,30 @@ def check_report(report: dict, budgets: dict) -> List[str]:
     return violations
 
 
+def check_fleet(payload: dict, budgets: dict) -> List[str]:
+    """Evaluate the "fleet" budget section against an /api/fleet
+    payload: rollup bounds (min_live / min_ready / max_instances) over
+    the whole fleet, every other bound per instance — one stale or
+    backed-up member is a violation, not an average."""
+    violations: List[str] = []
+    rollup = {"live": payload.get("live"), "ready": payload.get("ready"),
+              "instances": len(payload.get("instances") or ())}
+    per_instance = {}
+    for key, bound in budgets.items():
+        if key.startswith("_"):
+            continue
+        field = key[4:]
+        if field in rollup:
+            violations.extend(
+                f"fleet {v}" for v in check_report(rollup, {key: bound}))
+        else:
+            per_instance[key] = bound
+    for row in payload.get("instances") or ():
+        for v in check_report(row, per_instance):
+            violations.append(f"instance {row.get('instance')!r}: {v}")
+    return violations
+
+
 def _section_for(report: dict, budgets: dict,
                  override: Optional[str]) -> Optional[str]:
     if override:
@@ -113,15 +144,20 @@ def main(argv=None) -> int:
     ap.add_argument("--bench", default=None,
                     help="bench result JSON with a 'config' key (e.g. "
                          "perf_probe/serve_bench output)")
+    ap.add_argument("--fleet", default=None,
+                    help="fleet snapshot JSON (/api/fleet payload or "
+                         "fleet_demo.py --out) gated per instance "
+                         "against the 'fleet' section")
     ap.add_argument("--section", default=None,
                     help="budget section to apply (default: the "
                          "report's 'kind' or the bench's 'config')")
     args = ap.parse_args(argv)
 
-    if not args.report and not args.bench:
-        print("check_budgets: need --report or --bench", file=sys.stderr)
+    if not args.report and not args.bench and not args.fleet:
+        print("check_budgets: need --report, --bench or --fleet",
+              file=sys.stderr)
         return 2
-    path = args.report or args.bench
+    path = args.report or args.bench or args.fleet
     try:
         with open(path) as f:
             report = json.load(f)
@@ -130,6 +166,22 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"check_budgets: {e}", file=sys.stderr)
         return 2
+
+    if args.fleet:
+        section = args.section or "fleet"
+        if section not in budgets:
+            print(f"check_budgets: no {section!r} section in "
+                  f"{args.budgets}", file=sys.stderr)
+            return 2
+        violations = check_fleet(report, budgets[section])
+        if violations:
+            for v in violations:
+                print(f"BUDGET VIOLATION [{section}]: {v}")
+            return 1
+        n = len(report.get("instances") or ())
+        print(f"budgets OK [{section}]: {n} instance(s) checked, "
+              "0 violated")
+        return 0
 
     # a serve_bench.py --out file: gate the embedded drain RunReport,
     # folding in the summary rollup (p99, rows/sec, waste fraction)

@@ -100,10 +100,14 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
     ``requests_per_client`` single-row /predict posts over one
     persistent connection. Returns rows/sec + latency percentiles and a
     row-exactness verdict."""
+    from deeplearning4j_tpu.observability.distributed import (TRACE_HEADER,
+                                                              new_trace_id)
     lats: list[float] = []
     lock = threading.Lock()
     errors: list[str] = []
     mismatches = [0]
+    # trace-context propagation receipts: ids sent, ids echoed back
+    trace_ids = {"sent": 0, "echoed": 0}
     start_gate = threading.Event()
 
     def client(tid: int):
@@ -111,6 +115,7 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
 
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
         my_lats = []
+        my_sent = my_echoed = 0
         try:
             conn.connect()
             # Nagle off: header and body go out as separate sends, and
@@ -120,12 +125,19 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
             for r in range(requests_per_client):
                 i = (tid * requests_per_client + r) % x.shape[0]
                 body = json.dumps({"features": x[i:i + 1].tolist()})
+                # every request carries its own trace id; a conforming
+                # server echoes it and stamps it onto its batcher spans
+                trace_id = new_trace_id()
+                my_sent += 1
                 t0 = time.perf_counter()
                 conn.request("POST", "/predict", body,
-                             {"Content-Type": "application/json"})
+                             {"Content-Type": "application/json",
+                              TRACE_HEADER: trace_id})
                 resp = conn.getresponse()
                 data = resp.read()
                 my_lats.append(time.perf_counter() - t0)
+                if resp.getheader(TRACE_HEADER) == trace_id:
+                    my_echoed += 1
                 if resp.status != 200:
                     with lock:
                         errors.append(f"HTTP {resp.status}: {data[:120]!r}")
@@ -141,6 +153,8 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
             conn.close()
             with lock:
                 lats.extend(my_lats)
+                trace_ids["sent"] += my_sent
+                trace_ids["echoed"] += my_echoed
 
     threads = [threading.Thread(target=client, args=(t,))
                for t in range(concurrency)]
@@ -168,6 +182,12 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
         "p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99),
         "bit_identical": mismatches[0] == 0,
         "mismatched_rows": mismatches[0],
+        # echo rate is 1.0 against ModelServer; the serialized baseline
+        # predates trace propagation and reports 0.0 honestly
+        "trace_ids_sent": trace_ids["sent"],
+        "trace_id_echo_rate": round(
+            trace_ids["echoed"] / trace_ids["sent"], 4)
+        if trace_ids["sent"] else None,
     }
 
 
