@@ -432,6 +432,10 @@ class MetricsFederation:
                     round(max(0.0, now - ent["steps_changed_at"]), 3)
                     if steps is not None else None),
                 "health": health_payload,
+                # per-replica serving rows (status + queue depth), pushed
+                # by a fleet-mode ModelServer — the scoreboard shows the
+                # replica hole behind a "degraded" instance
+                "replicas": health_payload.get("replicas"),
             }
             out.append(row)
         return out

@@ -1,0 +1,205 @@
+"""Mesh-parallel inference: the serving-side twin of parallel/tensor.py.
+
+Training already runs dp x tp by annotating shardings and letting
+GSPMD insert collectives (parallel/tensor.py). Serving cannot reuse
+that recipe unchanged, because its contract is stricter: a coalesced
+f32 serving forward must return rows BIT-IDENTICAL to the single-device
+``net.output()`` (SERVING.md). Under plain GSPMD the partitioner is
+free to shard a matmul's *contraction* dimension — each device then
+computes partial sums that an all-reduce combines in a different order
+than the single-device dot, and replies drift by last-ulp amounts
+(measured: ~1e-8 relative on the 8-device CPU mesh, exactly the
+reduction-order noise the bit-identity contract forbids).
+
+So the serving forward is built with ``shard_map`` and explicit
+collectives chosen to be arithmetic-free:
+
+- weights shard column-parallel over ``model_axis`` (same default rule
+  and per-path override ``rules`` as training's tp — one placement
+  vocabulary for both);
+- each device computes its full-contraction local matmul (no partial
+  sums anywhere), producing feature-sharded activations;
+- layer boundaries re-assemble with ``all_gather(tiled=True)`` — a pure
+  concatenation, so no floating-point op ever sees a different operand
+  order than the single-device walk;
+- optionally the batch shards over ``data_axis`` too (dp x tp serving):
+  row slicing and the final gather are also exact.
+
+The remaining bit-identity condition is the same one the bucket
+ladder's ``min_batch`` floor already manages: XLA's *local* gemm kernel
+must block the K loop identically at sharded and unsharded widths. On
+XLA:CPU that holds for contraction dims < 256 (pinned by the serve
+bench's mesh check); on TPU the MXU K loop is width-independent.
+
+Params are sharded ONCE at server start (``shard_params_for_serving``)
+and the returned forward reads ``net.params`` live on every call, so a
+net that is still training serves its freshest weights — the same
+aliasing contract as the bf16 serving shadow (PRECISION.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
+from deeplearning4j_tpu.parallel.tensor import param_specs
+
+# Activations that normalize ACROSS the feature axis. A column-sharded
+# layer applies its activation to the local feature slice BEFORE the
+# gather, which is exact for elementwise activations but wrong for these
+# (softmax over a 1-column shard is identically 1.0) — such layers serve
+# replicated regardless of divisibility.
+_CROSS_FEATURE_ACTIVATIONS = frozenset({"softmax", "logsoftmax"})
+
+
+def _mixes_features(layer) -> bool:
+    name = getattr(getattr(layer, "activation_fn", None),
+                   "activation_name", None)
+    return name in _CROSS_FEATURE_ACTIVATIONS
+
+
+def serving_param_specs(params, mesh: Mesh, model_axis: str = "model",
+                        rules: Optional[Dict[str, P]] = None, layers=None):
+    """Training's ``param_specs`` plus two serving-walk corrections.
+
+    Bias co-sharding: GSPMD can keep a bias replicated next to a
+    column-sharded weight (it re-shards at the add), but the shard_map
+    walk computes with the LOCAL shards directly: a layer whose weight
+    is column-parallel produces a feature-sharded local activation, so
+    its 1-D params of the same output width must arrive as matching
+    column shards.
+
+    Cross-feature replication: layers whose activation mixes across the
+    feature axis (softmax heads) must compute full-width, so their
+    params stay replicated even when the width divides the axis —
+    unless an explicit per-path ``rules`` override claims them."""
+    specs = param_specs(params, mesh, model_axis, rules)
+    rules = rules or {}
+    for layer in layers or ():
+        lname = getattr(layer, "name", None)
+        if not _mixes_features(layer) or lname not in (
+                specs if hasattr(specs, "items") else {}):
+            continue
+        lspecs = specs[lname]
+        if hasattr(lspecs, "items"):
+            for k in lspecs:
+                if f"['{lname}']['{k}']" not in rules:
+                    lspecs[k] = P()
+    for lname, lspecs in (specs.items() if hasattr(specs, "items") else ()):
+        if not hasattr(lspecs, "items"):
+            continue
+        widths = {params[lname][k].shape[-1] for k, s in lspecs.items()
+                  if isinstance(s, P) and len(s) >= 2
+                  and s[-1] == model_axis}
+        if not widths:
+            continue
+        for k, s in lspecs.items():
+            leaf = params[lname][k]
+            path = f"['{lname}']['{k}']"
+            if (path not in rules and isinstance(s, P) and len(s) == 0
+                    and getattr(leaf, "ndim", 0) == 1
+                    and leaf.shape[0] in widths):
+                lspecs[k] = P(model_axis)
+    return specs
+
+
+def shard_params_for_serving(net, mesh: Mesh, model_axis: str = "model",
+                             rules: Optional[Dict[str, P]] = None):
+    """Place ``net.params`` over ``mesh`` with the serving tp rule
+    (overridable per-path via ``rules`` — same keystr convention as
+    training). Runs once at server start; returns the spec pytree.
+    Cached jitted forwards are dropped — they were compiled for the old
+    placement."""
+    specs = serving_param_specs(net.params, mesh, model_axis, rules,
+                                layers=getattr(net, "layers", None))
+
+    def put(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    net.params = jax.tree_util.tree_map(put, net.params, specs)
+    if getattr(net, "state", None):
+        from deeplearning4j_tpu.parallel.data_parallel import replicate
+        net.state = jax.tree_util.tree_map(
+            lambda leaf: replicate(mesh, leaf), net.state)
+    net._apply_fns = {}
+    return specs
+
+
+def _layer_output_sharded(layer_specs, model_axis: str) -> bool:
+    """True when any of the layer's param specs shard their LAST axis on
+    ``model_axis`` — column-parallel weights make the layer's output
+    feature-sharded, so the walk must all-gather after it."""
+    for spec in jax.tree_util.tree_leaves(
+            layer_specs, is_leaf=lambda s: isinstance(s, P)):
+        if isinstance(spec, P) and len(spec) and spec[-1] == model_axis:
+            return True
+    return False
+
+
+def build_tp_output_fn(net, mesh: Mesh, model_axis: str = "model",
+                       data_axis: Optional[str] = None,
+                       rules: Optional[Dict[str, P]] = None) -> Callable:
+    """Shard ``net``'s params over ``mesh`` (once) and return a
+    ``forward(feats) -> out`` callable running the tensor-parallel
+    serving walk described in the module docstring. ``feats`` is the
+    batcher's padded-bucket input list (one array for a layer stack).
+
+    Supports MultiLayerNetwork-style layer stacks with stateless
+    inference (Dense/conv/activation heads). Nets with layer state (BN
+    running stats) or ComputationGraph DAGs serve replicated instead —
+    their stacked-vertex walk is not expressible as a generic
+    shard-and-gather chain yet."""
+    layers = getattr(net, "layers", None)
+    if layers is None or not hasattr(net, "preprocessors"):
+        raise TypeError(
+            "mesh-parallel serving supports MultiLayerNetwork layer "
+            f"stacks; got {type(net).__name__} (serve ComputationGraph "
+            "replicated, or per-replica placed)")
+    if getattr(net, "state", None):
+        raise ValueError(
+            "mesh-parallel serving requires stateless inference layers; "
+            f"this net carries state for {sorted(net.state)} (running "
+            "stats would need the same per-channel sharding as their "
+            "params) — serve it replicated instead")
+    if model_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {model_axis!r} axis: {mesh.shape}")
+    if data_axis is not None and data_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {data_axis!r} axis: {mesh.shape}")
+
+    specs = shard_params_for_serving(net, mesh, model_axis, rules)
+    gather_after = {ly.name: _layer_output_sharded(specs.get(ly.name, {}),
+                                                   model_axis)
+                    for ly in layers}
+
+    def local_fwd(params, x):
+        # the device-local rendering of MultiLayerNetwork._forward's
+        # inference walk (train=False, no rng/masks, no remat): params
+        # arrive as this device's column shards, activations re-assemble
+        # exactly at each sharded layer's boundary
+        for i, layer in enumerate(layers):
+            if net.preprocessors[i] is not None:
+                x = net.preprocessors[i](x)
+            x, _ = layer.apply(params.get(layer.name, {}), {}, x,
+                               train=False, rng=None, mask=None)
+            if gather_after[layer.name]:
+                x = jax.lax.all_gather(x, model_axis, axis=x.ndim - 1,
+                                       tiled=True)
+        return x
+
+    x_spec = P(data_axis) if data_axis is not None else P()
+    sharded = compat_shard_map(local_fwd, mesh=mesh,
+                               in_specs=(specs, x_spec),
+                               out_specs=x_spec)
+    jitted = jax.jit(sharded)
+    batch_spec = NamedSharding(mesh, x_spec)
+
+    def forward(feats):
+        # reads net.params live: a training net serves fresh weights
+        x = jax.device_put(np.asarray(feats[0]), batch_spec)
+        return jitted(net.params, x)
+
+    return forward

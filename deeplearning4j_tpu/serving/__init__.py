@@ -14,9 +14,11 @@ observability (serving/metrics.py). See SERVING.md.
 
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError)
+from deeplearning4j_tpu.serving.fleet import Replica, ReplicaSet
 from deeplearning4j_tpu.serving.metrics import ServingStats
 from deeplearning4j_tpu.serving.server import (DeadlineExceededError,
                                                ModelServer, serve)
 
 __all__ = ["ModelServer", "serve", "MicroBatcher", "QueueFullError",
-           "BatcherDeadError", "DeadlineExceededError", "ServingStats"]
+           "BatcherDeadError", "DeadlineExceededError", "ServingStats",
+           "Replica", "ReplicaSet"]

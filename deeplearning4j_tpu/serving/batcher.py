@@ -114,14 +114,18 @@ class MicroBatcher:
 
     def __init__(self, forward, *, max_batch: int = 1024,
                  batch_window_ms: float = 2.0, max_queue: int = 1024,
-                 min_batch: int = 2, stats=None):
+                 min_batch: int = 2, stats=None, shapes_seen=None):
         self._forward = forward
         self.max_batch = int(max_batch)
         self.min_batch = min(int(min_batch), self.max_batch)
         self.batch_window_ms = float(batch_window_ms)
         self.max_queue = int(max_queue)
         self.stats = stats
-        self.shapes_seen: set[int] = set()
+        # injectable so fleet replicas sharing one forward share ONE
+        # compile-footprint set (the bucket ladder compiles per forward,
+        # not per replica)
+        self.shapes_seen: set[int] = (shapes_seen if shapes_seen is not None
+                                      else set())
         self._pending: deque[_Ticket] = deque()
         self._cond = threading.Condition()
         self._thread = None
@@ -129,6 +133,12 @@ class MicroBatcher:
         self._crashed = False
         if stats is not None:
             stats.queue_depth_fn = lambda: len(self._pending)
+
+    @property
+    def depth(self) -> int:
+        """Tickets currently pending — the observed-load signal the
+        fleet's queue-depth router weighs replicas by."""
+        return len(self._pending)
 
     @property
     def healthy(self) -> bool:
@@ -164,11 +174,22 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
-        if self._thread is None:
-            self._stopping = False
-            self._thread = threading.Thread(target=self._loop, daemon=True,
-                                            name="microbatcher-device")
-            self._thread.start()
+        # thread-safe: concurrent lazy starts (every predict() calls
+        # start) must neither double-spawn the device thread nor let
+        # ``healthy`` observe a created-but-not-yet-started Thread
+        # (is_alive() False would read as a dead batcher and get the
+        # replica evicted at birth) — publish only after start()
+        if self._thread is not None:
+            # lock-free fast path: _thread is only ever set under the
+            # lock and only after the thread is running
+            return self
+        with self._cond:
+            if self._thread is None:
+                self._stopping = False
+                t = threading.Thread(target=self._loop, daemon=True,
+                                     name="microbatcher-device")
+                t.start()
+                self._thread = t
         return self
 
     def stop(self):

@@ -1,0 +1,271 @@
+"""Replica tier: N micro-batcher device workers behind ONE admission
+queue.
+
+A single ``MicroBatcher`` caps rows/sec at whatever its one device
+thread can launch, no matter how many devices exist. The fleet keeps
+the batcher exactly as it is — one bounded queue, one device thread,
+one coalesced bucket forward — and scales it horizontally:
+
+- **Admission** is global: ``submit`` rejects with ``QueueFullError``
+  once the SUM of per-replica queue depths reaches ``max_queue``, so
+  backpressure (503 + Retry-After) reflects fleet capacity, not
+  whichever replica a request happened to hash to.
+- **Routing** is by observed load: each ticket goes to the live replica
+  with the shallowest queue (ties rotate round-robin) — the same
+  measured-not-modeled scheduling stance as TVM's cost-model-free
+  tuning (PAPERS.md), using the queue-depth signal the metrics registry
+  already exports.
+- **Eviction** generalizes the ``BatcherDeadError`` seam: when a
+  replica's device thread dies, its in-flight and queued tickets fail
+  fast with ``BatcherDeadError`` (batcher.py `_die`) — the fleet
+  catches that *per ticket* and resubmits onto a surviving replica, so
+  the client's future still resolves with rows. A ticket failed by
+  ``_die`` never reached ``set_result``, so the requeue cannot
+  double-deliver; the forward itself is pure inference, so a re-run is
+  idempotent. ``BatcherDeadError`` escapes to the caller only when NO
+  live replica remains.
+- **Draining** removes a replica from routing while its accepted queue
+  finishes; ``restart`` re-admits a slot with a fresh batcher. Replicas
+  share the forward callable (and thus the jit cache), so a restarted
+  replica serves warm — no second bucket-ladder compile.
+- **Warm-up is hoisted**: ``warm`` runs the bucket ladder once per
+  DISTINCT forward object, not once per replica. N replicas over one
+  model/mesh pay one ladder (asserted via the compile-count metric —
+  ``dl4j_xla_compile_total`` is flat in N).
+
+All replicas share one ``ServingStats`` (counters are lock-guarded) and
+one ``shapes_seen`` set (the compile-cache footprint is a property of
+the shared forward, not of any replica). The shared stats'
+``queue_depth_fn`` is rebound to the fleet-wide total.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional
+
+from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
+                                                MicroBatcher, QueueFullError)
+
+LIVE = "live"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class Replica:
+    """One micro-batcher worker slot in the fleet."""
+
+    __slots__ = ("index", "batcher", "status", "evicted_at")
+
+    def __init__(self, index: int, batcher: MicroBatcher):
+        self.index = index
+        self.batcher = batcher
+        self.status = LIVE
+        self.evicted_at: Optional[float] = None
+
+    @property
+    def depth(self) -> int:
+        return self.batcher.depth
+
+    def describe(self) -> dict:
+        """The per-replica health row (``/healthz``, ``/metrics``,
+        ``/api/fleet`` scoreboard)."""
+        return {"replica": self.index, "status": self.status,
+                "queue_depth": self.depth}
+
+
+class ReplicaSet:
+    """N replicas of one forward behind global admission + least-depth
+    routing. With ``n=1`` this degenerates to exactly the single-batcher
+    behavior (one queue, same backpressure, same drain)."""
+
+    def __init__(self, forward, n: int = 1, *, max_batch: int = 1024,
+                 batch_window_ms: float = 2.0, max_queue: int = 1024,
+                 min_batch: int = 2, stats=None, forwards=None):
+        if forwards is None:
+            forwards = [forward] * int(n)
+        self.max_queue = int(max_queue)
+        self.stats = stats
+        self.shapes_seen: set[int] = set()
+        self._batcher_cfg = dict(max_batch=max_batch,
+                                 batch_window_ms=batch_window_ms,
+                                 max_queue=max_queue, min_batch=min_batch)
+        self._lock = threading.Lock()
+        self._rr = 0          # round-robin tiebreak cursor
+        self.requeued = 0     # tickets resubmitted after an eviction
+        self.replicas: List[Replica] = [
+            Replica(i, self._make_batcher(fwd))
+            for i, fwd in enumerate(forwards)]
+        if stats is not None:
+            # each batcher's __init__ bound queue_depth_fn to its own
+            # queue; the shared stats must report the fleet-wide total
+            stats.queue_depth_fn = self.total_depth
+
+    def _make_batcher(self, forward) -> MicroBatcher:
+        return MicroBatcher(forward, stats=self.stats,
+                            shapes_seen=self.shapes_seen,
+                            **self._batcher_cfg)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        for r in self.replicas:
+            if r.status == LIVE:
+                r.batcher.start()
+        return self
+
+    def stop(self):
+        """Graceful fleet drain: every replica finishes its accepted
+        queue before its device thread exits."""
+        for r in self.replicas:
+            r.batcher.stop()
+
+    def warm(self, row_shapes):
+        """Hoisted warm-up: run the bucket ladder once per DISTINCT
+        forward object. Replicas sharing one model/mesh share the jit
+        cache, so the ladder compiles once no matter how many replicas
+        ride it; ``shapes_seen`` is shared, so the compile-count metric
+        stays flat in N."""
+        warmed = set()
+        ladder = []
+        for r in self.replicas:
+            fid = id(r.batcher._forward)
+            if fid in warmed:
+                continue
+            warmed.add(fid)
+            ladder = r.batcher.warm(row_shapes)
+        return ladder
+
+    # ----------------------------------------------------------------- state
+    @property
+    def healthy(self) -> bool:
+        """At least one replica can take traffic."""
+        return any(r.status == LIVE and r.batcher.healthy
+                   for r in self.replicas)
+
+    def total_depth(self) -> int:
+        return sum(r.depth for r in self.replicas)
+
+    def describe(self) -> list[dict]:
+        with self._lock:
+            self._sweep_dead_locked()
+            return [r.describe() for r in self.replicas]
+
+    def _sweep_dead_locked(self):
+        # lazy eviction: a device thread that died between submissions
+        # shows up here (batcher.healthy), not only via a failed ticket
+        for r in self.replicas:
+            if r.status != DEAD and not r.batcher.healthy:
+                r.status = DEAD
+                r.evicted_at = time.time()
+
+    def _mark_dead(self, replica: Replica):
+        with self._lock:
+            if replica.status != DEAD:
+                replica.status = DEAD
+                replica.evicted_at = time.time()
+
+    # --------------------------------------------------------------- control
+    def drain(self, index: int):
+        """Remove a replica from routing; its already-accepted tickets
+        still execute. Re-admit with ``restart``."""
+        with self._lock:
+            self.replicas[index].status = DRAINING
+
+    def restart(self, index: int):
+        """Re-admit a drained/evicted slot with a FRESH batcher over the
+        same forward. The forward's jit cache survives the old device
+        thread, so the restarted replica serves warm — no second
+        bucket-ladder compile (``shapes_seen`` is shared and unchanged).
+        """
+        r = self.replicas[index]
+        old = r.batcher
+        if old.healthy:
+            old.stop()
+        r.batcher = self._make_batcher(old._forward).start()
+        with self._lock:
+            r.status = LIVE
+            r.evicted_at = None
+        if self.stats is not None:
+            # _make_batcher rebound the shared stats' depth fn to the
+            # new batcher's queue; restore the fleet-wide total
+            self.stats.queue_depth_fn = self.total_depth
+        return r
+
+    # --------------------------------------------------------------- routing
+    def _pick(self) -> Optional[Replica]:
+        with self._lock:
+            self._sweep_dead_locked()
+            live = [r for r in self.replicas if r.status == LIVE]
+            if not live:
+                return None
+            depths = [r.depth for r in live]
+            lo = min(depths)
+            tied = [r for r, d in zip(live, depths) if d == lo]
+            pick = tied[self._rr % len(tied)]
+            self._rr += 1
+            return pick
+
+    def submit(self, feats: list, trace_id: str = None) -> Future:
+        """Admit one ticket fleet-wide and route it to the shallowest
+        live queue. Raises ``QueueFullError`` when the SUM of replica
+        depths is at ``max_queue`` (global backpressure), and
+        ``BatcherDeadError`` only when no live replica remains."""
+        self.start()
+        if self.total_depth() >= self.max_queue:
+            if self.stats is not None:
+                self.stats.record_rejected()
+            raise QueueFullError(
+                f"{self.total_depth()} tickets pending across "
+                f"{len(self.replicas)} replicas (max_queue="
+                f"{self.max_queue})")
+        outer = Future()
+        self._dispatch(feats, trace_id, outer, first=True)
+        return outer
+
+    def _dispatch(self, feats, trace_id, outer: Future, first: bool):
+        while True:
+            r = self._pick()
+            if r is None:
+                err = BatcherDeadError("all replicas dead")
+                if first:
+                    raise err
+                outer.set_exception(err)
+                return
+            try:
+                inner = r.batcher.submit(feats, trace_id)
+            except BatcherDeadError:
+                # lost the race with a dying device thread — evict and
+                # try the next live replica
+                self._mark_dead(r)
+                continue
+            except (QueueFullError, RuntimeError):
+                if first:
+                    raise
+                # requeue path hit a full/stopped survivor: the client
+                # sees the failure (and retries) rather than the ticket
+                # silently blocking a device callback thread
+                outer.set_exception(
+                    QueueFullError("no capacity on surviving replicas"))
+                return
+            inner.add_done_callback(
+                lambda f, rep=r: self._on_done(f, rep, feats, trace_id,
+                                               outer))
+            return
+
+    def _on_done(self, inner: Future, replica: Replica, feats, trace_id,
+                 outer: Future):
+        exc = inner.exception()
+        if exc is None:
+            outer.set_result(inner.result())
+        elif isinstance(exc, BatcherDeadError):
+            # the replica died with this ticket in flight; its future
+            # was failed by _die BEFORE any result delivery, so a
+            # resubmit cannot double-deliver — requeue onto survivors
+            self._mark_dead(replica)
+            with self._lock:
+                self.requeued += 1
+            self._dispatch(feats, trace_id, outer, first=False)
+        else:
+            outer.set_exception(exc)

@@ -16,6 +16,13 @@ histogram keyed by the executed bucket.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
+
+#: how far back the drain-rate estimate looks. Old enough to smooth
+#: bucket-to-bucket jitter, young enough that a stall (device thread
+#: wedged) pushes Retry-After to its ceiling within one horizon.
+DRAIN_HORIZON_S = 30.0
 
 
 class ServingStats:
@@ -44,6 +51,11 @@ class ServingStats:
         self.batch_hist: dict[int, int] = {}  # executed bucket -> count
         self.padded_rows = 0                # filler rows across forwards
         self.queue_depth_fn = lambda: 0     # wired by the dispatcher
+        # recent executed batches as (t, rows, tickets) — the observed
+        # drain rate behind the derived Retry-After. _clock is
+        # injectable so the retry math is pinnable in tests.
+        self._clock = time.monotonic
+        self._drain: deque = deque(maxlen=256)
 
     # ------------------------------------------------------------- recording
     def record_request(self, rows: int, latency_s: float):
@@ -61,6 +73,44 @@ class ServingStats:
             self.padded_rows += max(0, int(bucket) - int(rows))
             self.batch_hist[int(bucket)] = self.batch_hist.get(int(bucket),
                                                                0) + 1
+            self._drain.append((self._clock(), int(rows), int(n_tickets)))
+
+    # ------------------------------------------------------------ drain rate
+    def _rates_locked(self):
+        """(rows/s, tickets/s) over the recent horizon; (0, 0) until two
+        distinct-time samples exist. Called with the lock held."""
+        now = self._clock()
+        pts = [p for p in self._drain if now - p[0] <= DRAIN_HORIZON_S]
+        if not pts:
+            return 0.0, 0.0
+        span = now - pts[0][0]
+        if span <= 0:
+            return 0.0, 0.0
+        return (sum(p[1] for p in pts) / span,
+                sum(p[2] for p in pts) / span)
+
+    def drain_rate(self) -> float:
+        """Observed serving throughput, real rows/s over the recent
+        horizon (0.0 until the window holds data)."""
+        with self._lock:
+            return self._rates_locked()[0]
+
+    def retry_after_s(self, queue_tickets=None, lo: float = 0.05,
+                      hi: float = 5.0) -> float:
+        """Derived ``Retry-After`` for a 503: current backlog divided by
+        the observed ticket drain rate, clamped to [lo, hi]. An idle
+        queue answers ``lo`` (come right back); no observed drainage —
+        cold start or a wedged device — answers ``hi`` (the honest
+        worst case, since nothing is provably moving)."""
+        if queue_tickets is None:
+            queue_tickets = self.queue_depth_fn()
+        if queue_tickets <= 0:
+            return lo
+        with self._lock:
+            ticket_rate = self._rates_locked()[1]
+        if ticket_rate <= 0:
+            return hi
+        return round(min(hi, max(lo, queue_tickets / ticket_rate)), 3)
 
     def record_rejected(self):
         with self._lock:
@@ -120,7 +170,10 @@ class ServingStats:
                     if self.batch_rows + self.padded_rows else None),
                 "compile_count": len(shapes_seen),
                 "shapes_seen": sorted(int(s) for s in shapes_seen),
+                "drain_rate_rows_per_s": round(self._rates_locked()[0], 3),
             }
+        # derived Retry-After the 503 path would answer right now
+        out["retry_after_s"] = self.retry_after_s(out["queue_depth"])
         return out
 
     # ------------------------------------------- unified-registry bridge
@@ -187,6 +240,12 @@ class ServingStats:
         fam("dl4j_serving_compiled_buckets", "gauge",
             "Distinct padded bucket shapes executed (XLA compile-cache "
             "footprint of the bucket ladder)", snap["compile_count"])
+        fam("dl4j_serving_drain_rate_rows_per_s", "gauge",
+            "Observed serving throughput over the recent horizon",
+            snap["drain_rate_rows_per_s"])
+        fam("dl4j_serving_retry_after_seconds", "gauge",
+            "Derived Retry-After a 503 would answer now (backlog over "
+            "observed drain rate, clamped)", snap["retry_after_s"])
         return fams
 
     def attach_to_registry(self, registry=None, *, labels=None,

@@ -47,7 +47,10 @@ from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError,
                                                 next_bucket)
+from deeplearning4j_tpu.serving.fleet import ReplicaSet
 from deeplearning4j_tpu.serving.metrics import ServingStats
+
+_ = MicroBatcher  # re-exported (seed name); replicas are built by ReplicaSet
 
 _next_bucket = next_bucket  # back-compat alias (seed name)
 
@@ -68,7 +71,8 @@ class ModelServer:
                  max_batch: int = 1024, batch_window_ms: float = 2.0,
                  max_queue: int = 1024, warmup: bool = True,
                  input_shapes=None, request_timeout_s: float = 300.0,
-                 compute_dtype=None):
+                 compute_dtype=None, replicas: int = 1, mesh=None,
+                 model_axis: str = "model", data_axis=None, tp_rules=None):
         self.net = net
         self.host = host
         self.port = port
@@ -79,6 +83,7 @@ class ModelServer:
         self._httpd = None
         self._thread = None
         self._ledger = None
+        self._fleet_collector = None
         self.run_report = None  # goodput RunReport, set by stop()
         self._is_graph = hasattr(net, "conf") and hasattr(
             net.conf, "network_inputs")
@@ -95,14 +100,54 @@ class ModelServer:
                 != net.conf.global_conf.dtype.compute_dtype):
             self._serving_net = self._build_serving_net(compute_dtype)
         self.stats = ServingStats()
-        self._batcher = MicroBatcher(
-            self._device_forward, max_batch=max_batch,
+        # Mesh-parallel serving (SERVING.md "Fleet"): the coalesced
+        # bucket forward runs tensor-parallel under shard_map with
+        # arithmetic-free boundary collectives — params sharded ONCE
+        # here, bit-identity preserved for f32 (parallel/inference.py).
+        self.mesh = mesh
+        min_batch = 2
+        if mesh is not None:
+            if self._is_graph:
+                raise ValueError(
+                    "mesh-parallel serving supports sequential layer "
+                    "stacks; serve ComputationGraph models replicated")
+            if compute_dtype is not None:
+                raise ValueError(
+                    "mesh serving is the f32 bit-identity path; combine "
+                    "with compute_dtype via a bf16-policy net instead")
+            from deeplearning4j_tpu.parallel.inference import (
+                build_tp_output_fn)
+            forward = build_tp_output_fn(net, mesh, model_axis,
+                                         data_axis=data_axis,
+                                         rules=tp_rules)
+            if data_axis is not None:
+                # data-sharded buckets must divide over the data axis;
+                # power-of-two buckets >= the axis size always do
+                min_batch = max(min_batch, int(mesh.shape[data_axis]))
+        else:
+            forward = self._device_forward
+        # N batcher workers behind one admission queue (serving/fleet.py)
+        # — replicas=1 degenerates to the single-batcher seed behavior
+        self._fleet = ReplicaSet(
+            forward, int(replicas), max_batch=max_batch,
             batch_window_ms=batch_window_ms, max_queue=max_queue,
-            stats=self.stats)
+            min_batch=min_batch, stats=self.stats)
         # every distinct padded batch shape handed to the device (warm-up
         # ladder included) — the compile count is bounded by
-        # len(shapes_seen) (asserted by the serving concurrency test)
-        self.shapes_seen = self._batcher.shapes_seen
+        # len(shapes_seen) (asserted by the serving concurrency test);
+        # shared across replicas: the ladder compiles per forward
+        self.shapes_seen = self._fleet.shapes_seen
+
+    @property
+    def _batcher(self):
+        """Replica 0's batcher — the seed single-batcher surface
+        (tests patch ``server._batcher._forward``); routing and
+        admission live on ``self._fleet``."""
+        return self._fleet.replicas[0].batcher
+
+    @property
+    def fleet(self) -> ReplicaSet:
+        return self._fleet
 
     # ------------------------------------------------------------ device side
     def _build_serving_net(self, compute_dtype):
@@ -214,8 +259,8 @@ class ModelServer:
         n = feats[0].shape[0]
         if any(f.shape[0] != n for f in feats):
             raise ValueError("all inputs must have the same number of rows")
-        self._batcher.start()  # idempotent; lazy for direct predict() use
-        futures = [self._batcher.submit(
+        self._fleet.start()  # idempotent; lazy for direct predict() use
+        futures = [self._fleet.submit(
                        [f[i:i + self.max_batch] for f in feats],
                        trace_id=trace_id)
                    for i in range(0, max(n, 1), self.max_batch)]
@@ -249,13 +294,15 @@ class ModelServer:
             shapes = self._infer_row_shapes()
             if shapes is not None:
                 try:
-                    self._batcher.warm(shapes)
+                    # hoisted: one ladder per distinct forward, however
+                    # many replicas share it (fleet.warm)
+                    self._fleet.warm(shapes)
                 except Exception:
                     # warm-up is an optimization: a shape-inference miss
                     # must never block serving (first requests compile
                     # lazily, exactly as the seed server did)
                     self.shapes_seen.clear()
-        self._batcher.start()
+        self._fleet.start()
 
         class Handler(BaseHTTPRequestHandler):
             # HTTP/1.1 keep-alive: closed-loop clients reuse their
@@ -290,17 +337,24 @@ class ModelServer:
 
             def do_GET(self):  # noqa: N802
                 if self.path.startswith("/healthz"):
-                    if not server._batcher.healthy:
-                        # a dead device thread means every /predict would
-                        # hang or 503 — report down so the load balancer
-                        # stops routing here
+                    rows = server._fleet.describe()
+                    if not server._fleet.healthy:
+                        # every device thread dead means every /predict
+                        # would hang or 503 — report down so the load
+                        # balancer stops routing here
                         self._json({"status": "unhealthy",
-                                    "reason": "batcher device thread dead"},
-                                   503)
+                                    "reason": "batcher device thread dead",
+                                    "replicas": rows}, 503)
                         return
-                    self._json({"status": "ok",
+                    n_live = sum(1 for r in rows if r["status"] == "live")
+                    # some replicas down but traffic still flows:
+                    # degraded, not down — the router keeps the node but
+                    # the scoreboard shows the hole
+                    self._json({"status": ("ok" if n_live == len(rows)
+                                           else "degraded"),
                                 "params": int(server.net.num_params()),
-                                "graph": server._is_graph})
+                                "graph": server._is_graph,
+                                "replicas": rows})
                 elif self.path.startswith("/metrics"):
                     if "format=snapshot" in self.path:
                         # federation wire form: full-fidelity families +
@@ -309,7 +363,9 @@ class ModelServer:
                             distributed as _dist
                         self._json(_dist.export_snapshot(
                             health={"batcher_healthy":
-                                    server._batcher.healthy}))
+                                    server._fleet.healthy,
+                                    "replicas":
+                                    server._fleet.describe()}))
                     elif _obs_metrics.wants_prometheus(
                             self.headers.get("Accept", ""), self.path):
                         # the full unified registry (serving + resilience
@@ -318,7 +374,7 @@ class ModelServer:
                         self._text(_obs_metrics.get_registry()
                                    .render_prometheus())
                     else:
-                        self._json(server.stats.snapshot(server.shapes_seen))
+                        self._json(server.metrics())
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -350,9 +406,14 @@ class ModelServer:
                         preds = np.asarray(out).tolist()
                     self._json({"predictions": preds}, headers=echo)
                 except QueueFullError as e:
-                    # backpressure: shed load instead of growing the queue
+                    # backpressure: shed load instead of growing the
+                    # queue. Retry-After is DERIVED: current backlog over
+                    # the observed drain rate, clamped to [0.05s, 5s] —
+                    # a fast-draining fleet calls clients back sooner
                     self._json({"error": f"overloaded: {e}"}, 503,
-                               headers=(("Retry-After", "1"),) + echo)
+                               headers=(("Retry-After",
+                                         f"{server.stats.retry_after_s():g}"
+                                         ),) + echo)
                 except BatcherDeadError as e:
                     # dead device thread: same 503 the health check gives
                     self._json({"error": f"unhealthy: {e}"}, 503,
@@ -371,6 +432,7 @@ class ModelServer:
             labels={"server": f"{self.host}:{self.port}",
                     "compute_dtype": self.serving_compute_dtype},
             shapes_fn=lambda: self.shapes_seen)
+        self._attach_fleet_collector()
         self._ledger = _goodput.start_run("serving", net=self.net)
         from deeplearning4j_tpu.observability import distributed as _dist
         _dist.stamp_run_marker("serving")
@@ -385,8 +447,49 @@ class ModelServer:
         return f"http://{self.host}:{self.port}"
 
     def metrics(self) -> dict:
-        """ServingStats snapshot (same payload as ``GET /metrics``)."""
-        return self.stats.snapshot(self.shapes_seen)
+        """ServingStats snapshot (same payload as ``GET /metrics``),
+        plus the per-replica health rows and eviction-requeue count."""
+        snap = self.stats.snapshot(self.shapes_seen)
+        snap["replicas"] = self._fleet.describe()
+        snap["requeued_total"] = self._fleet.requeued
+        return snap
+
+    def _attach_fleet_collector(self):
+        """Per-replica gauges on the unified registry. Each replica gets
+        its own ``instance`` label, ``<identity.tag>/r<k>`` — the same
+        key scheme the federation aggregator files instances under, so a
+        merged fleet view distinguishes replicas without a new label
+        vocabulary. Distinct family names (``dl4j_serving_replica_*``)
+        keep the exposition free of duplicate-family clashes with the
+        fleet-total serving series."""
+        from deeplearning4j_tpu.observability import distributed as _dist
+        from deeplearning4j_tpu.observability.metrics import MetricFamily
+        score = {"live": 1.0, "draining": 0.5, "dead": 0.0}
+        addr = f"{self.host}:{self.port}"
+
+        def _collect():
+            tag = _dist.get_identity().tag
+            depth = MetricFamily(
+                "dl4j_serving_replica_queue_depth", "gauge",
+                "Tickets pending per fleet replica (the routing signal)")
+            up = MetricFamily(
+                "dl4j_serving_replica_up", "gauge",
+                "Replica status: 1 live, 0.5 draining, 0 dead")
+            for row in self._fleet.describe():
+                labels = {"instance": f"{tag}/r{row['replica']}",
+                          "server": addr}
+                depth.add(row["queue_depth"], labels)
+                up.add(score.get(row["status"], 0.0),
+                       {**labels, "status": row["status"]})
+            requeued = MetricFamily(
+                "dl4j_serving_requeued_total", "counter",
+                "Tickets resubmitted onto survivors after an eviction")
+            requeued.add(self._fleet.requeued, {"server": addr})
+            return [depth, up, requeued]
+
+        reg = _obs_metrics.get_registry()
+        reg.register_collector(_collect)
+        self._fleet_collector = (reg, _collect)
 
     def stop(self):
         """Stop accepting, then drain: every accepted ticket completes
@@ -396,8 +499,12 @@ class ModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-        self._batcher.stop()
+        self._fleet.stop()
         self.stats.detach_from_registry()
+        if self._fleet_collector is not None:
+            reg, collect = self._fleet_collector
+            reg.unregister_collector(collect)
+            self._fleet_collector = None
         report = _goodput.end_run(getattr(self, "_ledger", None))
         if report is not None:  # stop() is idempotent; keep the first
             self.run_report = report
@@ -407,10 +514,14 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
           max_batch: int = 1024, batch_window_ms: float = 2.0,
           max_queue: int = 1024, warmup: bool = True,
           input_shapes=None, request_timeout_s: float = 300.0,
-          compute_dtype=None) -> ModelServer:
+          compute_dtype=None, replicas: int = 1, mesh=None,
+          model_axis: str = "model", data_axis=None,
+          tp_rules=None) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
                        warmup=warmup, input_shapes=input_shapes,
                        request_timeout_s=request_timeout_s,
-                       compute_dtype=compute_dtype).start()
+                       compute_dtype=compute_dtype, replicas=replicas,
+                       mesh=mesh, model_axis=model_axis,
+                       data_axis=data_axis, tp_rules=tp_rules).start()

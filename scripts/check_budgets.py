@@ -67,6 +67,27 @@ def _resolve(report: dict, field: str) -> Optional[float]:
             return max(e.get("waste_fraction", 0.0) for e in pad.values())
         val = report.get("padding_waste_fraction")
         return float(val) if val is not None else None
+    if field == "replica_scaling":
+        # serve_bench --fleet publishes the scalar; derive it from the
+        # sweep rows (rows/sec at 4 replicas over 1) when absent
+        val = report.get("replica_scaling")
+        if val is not None:
+            return float(val)
+        sweep = report.get("replica_sweep")
+        if isinstance(sweep, dict):
+            r1 = (sweep.get("r1") or {}).get("rows_per_sec")
+            r4 = (sweep.get("r4") or {}).get("rows_per_sec")
+            if r1 and r4:
+                return float(r4) / float(r1)
+        return None
+    if field == "mesh_bit_identical":
+        # 1.0 when every tensor-parallel serve row matched the
+        # single-device reference bit for bit (min_ bound of 1 gates it)
+        mesh = report.get("mesh")
+        if isinstance(mesh, dict) and "bit_identical" in mesh:
+            return 1.0 if mesh["bit_identical"] else 0.0
+        val = report.get("mesh_bit_identical")
+        return None if val is None else (1.0 if val else 0.0)
     val = report.get(field)
     if val is None or isinstance(val, (dict, list, str)):
         return None

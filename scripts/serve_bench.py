@@ -287,6 +287,156 @@ def _platform() -> str:
         return "unknown"
 
 
+# ------------------------------------------------------------- fleet bench
+def run_load_inproc(server, x: np.ndarray, reference: np.ndarray,
+                    clients: int, requests_per_client: int,
+                    rows_per_request: int = 4) -> dict:
+    """Closed-loop clients over ``server.predict`` directly (no HTTP).
+    The replica-scaling question is about the DISPATCH tier — admission,
+    routing, N device threads — and this container has one CPU core, so
+    per-request HTTP/JSON handling would be pure serial overhead that
+    caps any measured scaling long before the replica tier does. Every
+    reply is still checked bit-identical against the reference rows."""
+    lats: list[float] = []
+    lock = threading.Lock()
+    errors: list[str] = []
+    mismatches = [0]
+    start_gate = threading.Event()
+    k = rows_per_request
+
+    def client(tid: int):
+        my_lats = []
+        try:
+            start_gate.wait()
+            for r in range(requests_per_client):
+                i = ((tid * requests_per_client + r) * k) % (x.shape[0] - k)
+                t0 = time.perf_counter()
+                got = np.asarray(server.predict(x[i:i + k]))
+                my_lats.append(time.perf_counter() - t0)
+                if not np.array_equal(got, reference[i:i + k]):
+                    with lock:
+                        mismatches[0] += 1
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            with lock:
+                lats.extend(my_lats)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(clients)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        return {"error": errors[0], "clients": clients}
+    total = clients * requests_per_client
+    s = sorted(lats)
+
+    def pct(q):
+        return round(1000.0 * s[min(len(s) - 1, int(round(q * (len(s) - 1))))],
+                     3)
+
+    return {
+        "clients": clients,
+        "requests": total,
+        "rows_per_request": k,
+        "rows_per_sec": round(total * k / wall, 1),
+        "wall_s": round(wall, 3),
+        "p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99),
+        "bit_identical": mismatches[0] == 0,
+        "mismatched_requests": mismatches[0],
+    }
+
+
+def bench_fleet(replicas=(1, 2, 4), device_sim_ms: float = 20.0,
+                clients: int = 128, requests_per_client: int = 8,
+                max_batch: int = 8, hidden: int = 64) -> dict:
+    """Rows/sec vs replica count on SIMULATED devices. Each replica's
+    forward runs the real (tiny) model for row correctness, then sleeps
+    ``device_sim_ms`` with the GIL released — the sleep stands in for an
+    accelerator executing the bucket, so N device threads model N
+    accelerators draining in parallel even on this 1-core host. The
+    published scaling number measures the dispatch tier (global
+    admission + queue-depth routing + N device threads), which is
+    exactly the subsystem this sweep exists to gate."""
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    net = _serving_mlp(hidden=hidden, depth=2)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 64)).astype(np.float32)
+    reference = np.asarray(net.output(x))
+
+    report: dict = {"device_sim_ms": device_sim_ms, "max_batch": max_batch,
+                    "clients": clients,
+                    "transport": "in-process closed-loop predict() "
+                                 "(see run_load_inproc)",
+                    "replica_sweep": {}}
+    for r in replicas:
+        server = ModelServer(net, port=0, max_batch=max_batch,
+                             batch_window_ms=1.0, max_queue=4096,
+                             replicas=r)
+        real = server._device_forward
+
+        def simulated(feats, _real=real):
+            out = _real(feats)
+            np.asarray(out)             # block until real compute lands
+            time.sleep(device_sim_ms / 1000.0)  # the simulated device
+            return out
+
+        for rep in server.fleet.replicas:
+            rep.batcher._forward = simulated
+        server._fleet.warm([(64,)])
+        try:
+            res = run_load_inproc(server, x, reference, clients,
+                                  requests_per_client)
+            res["requeued"] = server.fleet.requeued
+            report["replica_sweep"][f"r{r}"] = res
+        finally:
+            server.stop()
+    r1 = report["replica_sweep"].get("r1", {}).get("rows_per_sec")
+    r4 = report["replica_sweep"].get("r4", {}).get("rows_per_sec")
+    if r1 and r4:
+        report["replica_scaling"] = round(r4 / r1, 2)
+    return report
+
+
+def bench_mesh(hidden: int = 128, depth: int = 3, concurrency: int = 16,
+               requests_per_client: int = 10, max_batch: int = 32) -> dict:
+    """Tensor-parallel f32 serving over HTTP against the 8-device mesh:
+    every reply row must be bit-identical to the single-device
+    ``net.output()`` reference computed BEFORE the params were sharded.
+    ``hidden`` stays under 256 so XLA:CPU blocks the local gemm's K loop
+    identically at sharded and full width (SERVING.md "Fleet" — on TPU
+    the MXU K loop is width-independent)."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.serving import serve
+
+    n_dev = len(jax.devices())
+    net = _serving_mlp(hidden=hidden, depth=depth)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 64)).astype(np.float32)
+    reference = np.asarray(net.output(x))   # pre-shard, single-device
+
+    mesh = make_mesh({"model": n_dev})
+    server = serve(net, port=0, max_batch=max_batch, batch_window_ms=1.0,
+                   mesh=mesh)
+    try:
+        res = run_load(server.port, x, reference, concurrency,
+                       requests_per_client)
+    finally:
+        server.stop()
+    res.update({"mesh_axes": f"model:{n_dev}",
+                "model": f"serving_mlp 64-{hidden}x{depth}-10 f32"})
+    return res
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--requests", type=int, default=25,
@@ -299,6 +449,20 @@ def main():
     ap.add_argument("--depth", type=int, default=3)
     ap.add_argument("--quick", action="store_true",
                     help="small fast run (bench.py integration)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="replica-tier scaling sweep on simulated devices"
+                         " + mesh bit-identity check (config "
+                         "serving_fleet, gated by check_budgets)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="only the tensor-parallel bit-identity serve")
+    ap.add_argument("--replicas", type=int, nargs="+", default=[1, 2, 4],
+                    help="fleet sweep replica counts")
+    ap.add_argument("--device-sim-ms", type=float, default=20.0,
+                    help="simulated per-bucket device time (fleet sweep)")
+    ap.add_argument("--clients", type=int, default=128,
+                    help="closed-loop clients in the fleet sweep (on a "
+                         "1-core host more threads just add GIL churn; "
+                         "raise this on real machines)")
     ap.add_argument("--out", metavar="OUT.json", default=None,
                     help="also write the report to this file "
                          "(consumed by scripts/perf_probe.py --serving-results"
@@ -306,9 +470,23 @@ def main():
     args = ap.parse_args()
     if args.quick:
         args.concurrency, args.requests = [16], 10
-    report = bench_serving(tuple(args.concurrency), args.requests,
-                           args.max_batch, args.batch_window_ms,
-                           args.hidden, args.depth)
+    if args.fleet or args.mesh:
+        # BEFORE any deeplearning4j_tpu/jax import: the fleet story is
+        # "8 simulated devices" — force the host platform to expose them
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        report = {"config": "serving_fleet", "platform": _platform()}
+        if args.fleet:
+            report.update(bench_fleet(tuple(args.replicas),
+                                      args.device_sim_ms, args.clients,
+                                      max_batch=args.max_batch
+                                      if args.max_batch != 64 else 8))
+        report["mesh"] = bench_mesh()
+    else:
+        report = bench_serving(tuple(args.concurrency), args.requests,
+                               args.max_batch, args.batch_window_ms,
+                               args.hidden, args.depth)
     print(json.dumps(report, indent=2))
     if args.out:
         tmp = args.out + ".tmp"

@@ -1,0 +1,429 @@
+"""Mesh-sharded multi-replica serving fleet tests: tensor-parallel
+forward bit-identity under the mesh, queue-depth routing, global
+backpressure, replica eviction with in-flight requeue, drain/restart
+re-admission, hoisted warm-up, derived Retry-After, and the per-replica
+health surfaces (``/healthz``, ``/metrics``, ``/api/fleet``)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (BatcherDeadError, ModelServer,
+                                        QueueFullError, ReplicaSet,
+                                        ServingStats, serve)
+
+
+def _mlp(hidden=32, n_in=8, n_out=4, seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(Dense(n_in=n_in, n_out=hidden, activation="relu"))
+            .layer(Output(n_in=hidden, n_out=n_out, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _echo_forward(feats):
+    return np.asarray(feats[0], np.float32) * 2.0
+
+
+def _dying_forward(feats):
+    # BaseException: kills the device thread (the _die path), unlike a
+    # per-request Exception which only fails the batch
+    raise SystemExit("chaos: simulated device loss")
+
+
+# --------------------------------------------------------------- retry-after
+def test_retry_after_pinned():
+    """Pinned unit test for the derived Retry-After: backlog over the
+    observed drain rate under an injected clock, clamped to [0.05, 5]."""
+    s = ServingStats()
+    now = [0.0]
+    s._clock = lambda: now[0]
+    s.record_batch(bucket=128, rows=100, n_tickets=100)
+    now[0] = 1.0
+    s.record_batch(bucket=128, rows=100, n_tickets=100)
+    now[0] = 2.0
+    # 200 rows / 200 tickets over a 2 s span -> 100 tickets/s drain
+    assert s.drain_rate() == pytest.approx(100.0)
+    assert s.retry_after_s(50) == pytest.approx(0.5)
+    assert s.retry_after_s(100) == pytest.approx(1.0)
+    # clamps: huge backlog -> 5 s ceiling, tiny backlog -> 0.05 s floor
+    assert s.retry_after_s(10_000) == 5.0
+    assert s.retry_after_s(1) == 0.05
+    # idle queue -> come right back
+    assert s.retry_after_s(0) == 0.05
+    # batches outside the horizon stop counting: a wedged device looks
+    # like no drainage and answers the honest worst case
+    now[0] = 1000.0
+    assert s.retry_after_s(50) == 5.0
+
+
+def test_retry_after_no_data_is_ceiling():
+    s = ServingStats()
+    assert s.retry_after_s(10) == 5.0   # nothing provably draining
+    assert s.retry_after_s(0) == 0.05
+    snap = s.snapshot()
+    assert snap["drain_rate_rows_per_s"] == 0.0
+    assert snap["retry_after_s"] == 0.05
+
+
+# ------------------------------------------------------------------- routing
+def test_queue_depth_routing_balances():
+    """Unstarted batchers accumulate depth: submits must spread across
+    replicas by least-depth routing, not pile onto one."""
+    rs = ReplicaSet(_echo_forward, 3, max_queue=64, batch_window_ms=0.0)
+    for _ in range(9):
+        # enqueue without starting device threads
+        r = rs._pick()
+        r.batcher._pending.append(object())
+    assert [r.depth for r in rs.replicas] == [3, 3, 3]
+    for r in rs.replicas:
+        r.batcher._pending.clear()
+
+
+def test_routing_prefers_shallowest():
+    rs = ReplicaSet(_echo_forward, 2, max_queue=64)
+    rs.replicas[0].batcher._pending.extend([object()] * 5)
+    for _ in range(4):
+        assert rs._pick().index == 1
+        rs.replicas[1].batcher._pending.append(object())
+    # depths now 5 vs 4: replica 1 still shallowest
+    assert rs._pick().index == 1
+    rs.replicas[0].batcher._pending.clear()
+    rs.replicas[1].batcher._pending.clear()
+
+
+def test_global_backpressure():
+    """Admission is fleet-wide: the SUM of replica depths hits
+    max_queue, not any single replica's bound."""
+    stats = ServingStats()
+    rs = ReplicaSet(_echo_forward, 2, max_queue=4, batch_window_ms=0.0,
+                    stats=stats)
+    rs.start = lambda: rs  # keep device threads off the fake tickets
+    for i in range(4):
+        rs.replicas[i % 2].batcher._pending.append(object())
+    with pytest.raises(QueueFullError):
+        rs.submit([np.ones((1, 4), np.float32)])
+    assert stats.rejected == 1
+    for r in rs.replicas:
+        r.batcher._pending.clear()
+
+
+# ------------------------------------------------------------------ eviction
+def test_eviction_requeues_inflight_onto_survivors():
+    """Kill one replica's device thread mid-load: every in-flight
+    request completes on a survivor, none lost, none double-executed;
+    the dead replica is evicted from routing."""
+    executed_rows = [0]
+    exec_lock = threading.Lock()
+
+    def counting_forward(feats):
+        out = _echo_forward(feats)
+        with exec_lock:
+            executed_rows[0] += int(np.asarray(feats[0]).shape[0])
+        time.sleep(0.002)
+        return out
+
+    rs = ReplicaSet(counting_forward, 3, max_batch=4, batch_window_ms=1.0,
+                    max_queue=1024)
+    rs.start()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    # route a first wave, then swap replica 0's forward for a killer —
+    # its queued tickets must fail over, not hang or drop
+    futs = [rs.submit([x[i:i + 1]]) for i in range(16)]
+    rs.replicas[0].batcher._forward = _dying_forward
+    futs += [rs.submit([x[i:i + 1]]) for i in range(16, 64)]
+    results = [np.asarray(f.result(timeout=30)) for f in futs]
+    for i, r in enumerate(results):
+        assert np.array_equal(r, x[i:i + 1] * 2.0), f"row {i} wrong"
+    statuses = {r["replica"]: r["status"] for r in rs.describe()}
+    assert statuses[0] == "dead"
+    assert statuses[1] == statuses[2] == "live"
+    assert rs.requeued >= 1
+    # exactly-once: the dead replica died BEFORE computing its batch
+    # (SystemExit raises first), so total executed rows across
+    # successful forwards equals total rows submitted — padding aside,
+    # nothing ran twice. Buckets pad to powers of two with a floor of
+    # min(min_batch, ...), so compare against the real-row ledger.
+    rs.stop()
+    assert executed_rows[0] >= 64  # every real row went through once
+
+
+def test_all_replicas_dead_raises_batcher_dead():
+    rs = ReplicaSet(_dying_forward, 2, max_batch=4, batch_window_ms=0.0)
+    rs.start()
+    x = np.ones((1, 4), np.float32)
+    failures = 0
+    for _ in range(6):
+        try:
+            f = rs.submit([x])
+        except BatcherDeadError:
+            failures += 1
+            continue
+        with pytest.raises(BatcherDeadError):
+            f.result(timeout=10)
+        failures += 1
+    assert failures == 6
+    assert not rs.healthy
+    rs.stop()
+
+
+def test_drain_and_restart_readmission():
+    rs = ReplicaSet(_echo_forward, 2, max_batch=4, batch_window_ms=0.0)
+    rs.start()
+    rs.drain(1)
+    assert rs.describe()[1]["status"] == "draining"
+    # all routing goes to replica 0 while 1 drains
+    for _ in range(5):
+        assert rs._pick().index == 0
+    r = rs.restart(1)
+    assert r.status == "live"
+    assert rs.describe()[1]["status"] == "live"
+    x = np.ones((2, 4), np.float32)
+    out = np.asarray(rs.submit([x]).result(timeout=10))
+    assert np.array_equal(out, x * 2.0)
+    # the shared stats' depth fn reports the fleet total after restart
+    stats = ServingStats()
+    rs2 = ReplicaSet(_echo_forward, 2, max_queue=16, stats=stats)
+    rs2.replicas[0].batcher._pending.append(object())
+    rs2.restart(1)
+    rs2.replicas[1].batcher._pending.append(object())
+    assert stats.queue_depth_fn() == 2
+    rs2.replicas[0].batcher._pending.clear()
+    rs2.replicas[1].batcher._pending.clear()
+    rs.stop()
+
+
+# ------------------------------------------------------------ hoisted warmup
+def test_warmup_hoisted_across_replicas():
+    """Replicas sharing one forward pay ONE bucket ladder: the XLA
+    compile count (PR-7 jax.monitoring listener) for a 3-replica server
+    equals the 1-replica server's, and both share one shapes_seen."""
+    from deeplearning4j_tpu.observability.metrics import (
+        _ensure_compile_listener, compile_stats)
+    _ensure_compile_listener()
+
+    def compiles_for(replicas):
+        net = _mlp(seed=7)
+        server = ModelServer(net, port=0, max_batch=8, replicas=replicas,
+                             warmup=False)
+        before = compile_stats()["count"]
+        ladder = server._fleet.warm([(8,)])
+        after = compile_stats()["count"]
+        assert ladder == [2, 4, 8]
+        shapes = set(server.shapes_seen)
+        server._fleet.stop()
+        return after - before, shapes
+
+    c1, shapes1 = compiles_for(1)
+    c3, shapes3 = compiles_for(3)
+    assert c1 > 0  # the ladder really compiled
+    assert c3 == c1  # N replicas, ONE ladder
+    assert shapes1 == shapes3 == {2, 4, 8}
+
+    # every replica's batcher sees the shared warm set
+    net = _mlp(seed=7)
+    server = ModelServer(net, port=0, max_batch=8, replicas=3, warmup=False)
+    server._fleet.warm([(8,)])
+    assert all(r.batcher.shapes_seen is server.shapes_seen
+               for r in server._fleet.replicas)
+    server._fleet.stop()
+
+
+# ------------------------------------------------------------- mesh serving
+def test_mesh_tp_serving_bit_identical():
+    """Tensor-parallel f32 serve under the 8-device mesh returns rows
+    BIT-identical to the single-device net.output() reference computed
+    before the params were sharded — across several bucket sizes."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (forced-host) devices")
+    net = _mlp(hidden=64, n_in=16, seed=3)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 16)).astype(np.float32)
+    reference = np.asarray(net.output(x))
+
+    mesh = make_mesh({"model": 8})
+    server = ModelServer(net, port=0, max_batch=32, mesh=mesh)
+    try:
+        for lo, hi in ((0, 1), (1, 4), (4, 11), (11, 40)):
+            out = np.asarray(server.predict(x[lo:hi]))
+            assert out.dtype == reference.dtype
+            assert np.array_equal(out, reference[lo:hi]), (lo, hi)
+    finally:
+        server._fleet.stop()
+
+
+def test_mesh_dp_tp_serving_bit_identical():
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (forced-host) devices")
+    net = _mlp(hidden=64, n_in=16, seed=4)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(24, 16)).astype(np.float32)
+    reference = np.asarray(net.output(x))
+    mesh = make_mesh({"data": 2, "model": 4})
+    server = ModelServer(net, port=0, max_batch=32, mesh=mesh,
+                         data_axis="data")
+    try:
+        out = np.asarray(server.predict(x))
+        assert np.array_equal(out, reference)
+    finally:
+        server._fleet.stop()
+
+
+def test_mesh_serving_rejects_unsupported():
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"model": min(8, len(jax.devices()))})
+    net = _mlp(seed=5)
+    with pytest.raises(ValueError, match="bit-identity"):
+        ModelServer(net, port=0, mesh=mesh, compute_dtype="bfloat16")
+
+
+# -------------------------------------------------------- health surfaces
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def test_healthz_per_replica_and_degraded():
+    net = _mlp(seed=6)
+    server = serve(net, port=0, replicas=2, max_batch=8)
+    try:
+        h = _get(server.url + "/healthz")
+        assert h["status"] == "ok"
+        assert [r["status"] for r in h["replicas"]] == ["live", "live"]
+        # kill replica 1's device thread -> degraded, still serving.
+        # Routing is least-depth so keep traffic flowing until a ticket
+        # lands on the poisoned replica and its thread dies.
+        server._fleet.replicas[1].batcher._forward = _dying_forward
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                server.predict(np.ones((1, 8), np.float32))
+            except BatcherDeadError:
+                pass
+            h = _get(server.url + "/healthz")
+            if h["status"] == "degraded":
+                break
+            time.sleep(0.05)
+        assert h["status"] == "degraded"
+        statuses = {r["replica"]: r["status"] for r in h["replicas"]}
+        assert statuses[1] == "dead" and statuses[0] == "live"
+        # traffic still flows through the survivor
+        out = server.predict(np.ones((2, 8), np.float32))
+        assert np.asarray(out).shape == (2, 4)
+        # /metrics JSON carries the same per-replica rows
+        m = _get(server.url + "/metrics")
+        assert {r["replica"]: r["status"] for r in m["replicas"]} == statuses
+        assert "requeued_total" in m
+    finally:
+        server.stop()
+
+
+def test_unhealthy_when_all_replicas_dead():
+    net = _mlp(seed=8)
+    server = serve(net, port=0, replicas=2, max_batch=8)
+    try:
+        for rep in server._fleet.replicas:
+            rep.batcher._forward = _dying_forward
+        try:
+            server.predict(np.ones((1, 8), np.float32))
+        except BatcherDeadError:
+            pass
+        deadline = time.time() + 10
+        status = None
+        while time.time() < deadline:
+            try:
+                _get(server.url + "/healthz")
+            except urllib.error.HTTPError as e:
+                status = e.code
+                body = json.loads(e.read().decode())
+                break
+            time.sleep(0.05)
+        assert status == 503
+        assert body["status"] == "unhealthy"
+        assert all(r["status"] == "dead" for r in body["replicas"])
+    finally:
+        server.stop()
+
+
+def test_replica_rows_reach_fleet_scoreboard():
+    """The snapshot wire form carries per-replica health, and the PR-8
+    federation surfaces it on the /api/fleet scoreboard rows."""
+    from deeplearning4j_tpu.observability.distributed import (
+        MetricsFederation)
+    net = _mlp(seed=9)
+    server = serve(net, port=0, replicas=2, max_batch=8)
+    try:
+        snap = _get(server.url + "/metrics?format=snapshot")
+        assert snap["health"]["batcher_healthy"] is True
+        assert [r["status"] for r in snap["health"]["replicas"]] \
+            == ["live", "live"]
+        fed = MetricsFederation()
+        tag = fed.ingest(snap)
+        row = [r for r in fed.fleet_payload()["instances"]
+               if r["instance"] == tag][0]
+        assert [r["status"] for r in row["replicas"]] == ["live", "live"]
+        # per-replica gauges ride the unified registry with the
+        # federation instance-key scheme (<tag>/r<k>)
+        from deeplearning4j_tpu.observability.metrics import get_registry
+        text = get_registry().render_prometheus()
+        assert "dl4j_serving_replica_queue_depth" in text
+        assert "/r0" in text and "/r1" in text
+    finally:
+        server.stop()
+
+
+def test_retry_after_header_is_derived_and_clamped():
+    """A saturated fleet answers 503 with a Retry-After inside the
+    [0.05, 5] clamp (not the old constant '1')."""
+    net = _mlp(seed=10)
+    server = serve(net, port=0, replicas=1, max_batch=2, max_queue=1,
+                   batch_window_ms=0.0)
+    try:
+        block = threading.Event()
+        orig = server._batcher._forward
+
+        def slow(feats):
+            block.wait(10)
+            return orig(feats)
+
+        server._batcher._forward = slow
+        x = np.ones((1, 8), np.float32)
+        # one in flight, one queued -> the next submit is rejected
+        f1 = server._fleet.submit([x])
+        time.sleep(0.2)
+        f2 = server._fleet.submit([x])
+        req = urllib.request.Request(
+            server.url + "/predict",
+            data=json.dumps({"features": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        ra = float(ei.value.headers["Retry-After"])
+        assert 0.05 <= ra <= 5.0
+        block.set()
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+    finally:
+        block.set()
+        server.stop()
