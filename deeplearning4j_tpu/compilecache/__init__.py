@@ -1,0 +1,28 @@
+"""Cold-start & compile-time engine (ROADMAP item 4, TVM grounding:
+compilation artifacts and schedule choices are managed, measured state
+— not boot-time side effects).
+
+Three layers:
+
+- :mod:`cache` — the persistent XLA compilation cache as a first-class
+  knob: ``configure(dir)`` / the ``DL4J_TPU_COMPILE_CACHE`` env var wire
+  ``jax_compilation_cache_dir`` through ``ModelServer``/``serve()``/
+  ``fit``/``resilient_fit``; hit/miss traffic lands in
+  ``dl4j_xla_cache_hits_total`` / ``_misses_total`` and on RunReport.
+- :mod:`manifest` + :mod:`precompile` — AOT ``lower().compile()`` of
+  the serving bucket ladder and both nets' train steps at BUILD time
+  (scripts/precompile.py), persisting executables into the cache dir
+  with a schema'd JSON manifest the server validates at boot; a
+  mismatch warns and falls back to lazy compile.
+- :mod:`autotune` — replay a ``serve_bench --out`` traffic trace
+  offline and search the (bucket ladder, linger window) space for the
+  config minimizing p99 x padding waste; the server loads the winning
+  config via ``tuning_report=``.
+"""
+
+from deeplearning4j_tpu.compilecache.cache import (ENV_VAR, cache_dir,
+                                                   configure, deactivate,
+                                                   ensure_configured)
+
+__all__ = ["ENV_VAR", "cache_dir", "configure", "deactivate",
+           "ensure_configured"]

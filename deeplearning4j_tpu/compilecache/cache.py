@@ -1,0 +1,119 @@
+"""Persistent XLA compilation cache as a first-class runtime knob.
+
+jax has had an on-disk compilation cache for years
+(``jax_compilation_cache_dir``), but as shipped it is a config flag
+buried behind two more flags that silently disable it for small
+programs: entries are skipped below a 1-second compile-time floor and a
+minimum serialized size. A CI-sized model compiles in milliseconds, so
+the stock defaults cache *nothing* and every boot stays cold. This
+module owns the knob:
+
+- :func:`configure` points jax at a cache dir AND zeroes both floors,
+  so every executable — tiny CI ladder buckets included — persists.
+- The dir resolves from an explicit argument or the
+  ``DL4J_TPU_COMPILE_CACHE`` env var; reconfiguration mid-process works
+  (jax latches its cache handle on first use; we reset it).
+- Hit/miss traffic is observable: jax emits
+  ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` monitoring
+  events only while a cache is active, and observability.metrics folds
+  them into ``dl4j_xla_cache_hits_total`` / ``_misses_total`` plus the
+  RunReport ``xla_cache_hits``/``xla_cache_misses`` fields. A warm boot
+  of an unchanged server therefore *proves* itself: misses == 0 and the
+  run's ``compile_count`` ~ 0 (cache hits skip ``backend_compile``, the
+  event the compile counter rides).
+
+The cache key is the HLO module + compile options, so it is shared by
+lazy jit, warm-up ladders and AOT ``lower().compile()`` — precompiling
+at build time (compilecache.precompile) and serving later from the
+same dir hit the identical entries.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+#: env var consulted by :func:`ensure_configured` (fit / resilient_fit /
+#: serving all call it) — set it and every run in the process shares one
+#: persistent cache without touching call sites
+ENV_VAR = "DL4J_TPU_COMPILE_CACHE"
+
+_lock = threading.Lock()
+_configured: Optional[str] = None
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None when cold."""
+    return _configured
+
+
+def configure(path: Optional[str] = None) -> Optional[str]:
+    """Activate the persistent compilation cache at *path* (or at
+    ``$DL4J_TPU_COMPILE_CACHE`` when *path* is None). Idempotent per
+    dir; switching dirs mid-process resets jax's latched cache handle
+    so the new dir takes effect. Returns the active dir (None when
+    neither source names one — the knob stays off, nothing changes).
+
+    Also installs the compile/cache-event listener so hit/miss counters
+    are live even before the first ``install_runtime_metrics`` call.
+    """
+    global _configured
+    resolved = path or os.environ.get(ENV_VAR) or None
+    if not resolved:
+        return _configured
+    resolved = os.path.abspath(resolved)
+    with _lock:
+        if _configured == resolved:
+            return _configured
+        os.makedirs(resolved, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", resolved)
+        # stock floors (1s compile time, min serialized bytes) exist to
+        # keep huge fleets from caching trivia; here they would skip
+        # every CI-sized program — zero both so the cache is honest at
+        # any model size
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            # jax latches its cache handle on first compile; without a
+            # reset, configuring after any jit ran would silently keep
+            # the old (or no) cache
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        from deeplearning4j_tpu.observability.metrics import \
+            _ensure_compile_listener
+        _ensure_compile_listener()
+        _configured = resolved
+    return _configured
+
+
+def deactivate() -> None:
+    """Turn the persistent cache back off: unset the dir, restore jax's
+    stock floors, and drop the latched cache handle so later compiles
+    run cold again. Process-global, like :func:`configure` — meant for
+    tear-down (tests, embedding hosts), not the serving hot path."""
+    global _configured
+    with _lock:
+        if _configured is None:
+            return
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        _configured = None
+
+
+def ensure_configured() -> Optional[str]:
+    """Env-driven activation: a no-op unless ``DL4J_TPU_COMPILE_CACHE``
+    is set (or :func:`configure` already ran). The fit loops, the
+    supervisor and the server call this at run start, so exporting one
+    env var turns on warm boots across the whole stack."""
+    return configure(None)

@@ -1,0 +1,148 @@
+"""AOT precompile manifest: the schema'd receipt that rides next to a
+persistent compilation cache.
+
+The cache dir alone is opaque — a directory of hashed executables says
+nothing about WHAT was precompiled. The manifest records it: model
+fingerprint (params-pytree paths/shapes/dtypes), dtype policy, serving
+row shapes + bucket ladder, mesh axes, jax version and backend. At
+boot the server validates its own configuration against the manifest
+(:func:`validate_serving`); any mismatch means the cached executables
+were built for a DIFFERENT program, so the server warns and falls back
+to lazy compile instead of trusting a stale artifact — the same
+contract a schema-versioned checkpoint gives restore.
+
+The manifest never gates correctness (the cache is keyed by HLO, a
+mismatched entry simply misses); it gates *expectations* — a boot that
+believes it is warm but compiles everything fresh is a silent perf
+regression this file makes loud.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import List, Optional
+
+SCHEMA_VERSION = 1
+#: default manifest filename inside a cache dir — the server looks here
+#: when ``aot_manifest`` isn't given explicitly
+MANIFEST_NAME = "aot_manifest.json"
+
+
+def model_fingerprint(net) -> str:
+    """sha256 (truncated) over the params pytree structure: every leaf's
+    path, shape and dtype, plus the net class. Two nets with the same
+    fingerprint lower to the same parameter signature — the precondition
+    for their cached executables to be interchangeable."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(net.params)
+    items = [(jax.tree_util.keystr(path), list(getattr(leaf, "shape", ())),
+              str(getattr(leaf, "dtype", "?")))
+             for path, leaf in flat]
+    blob = json.dumps([type(net).__name__, items], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _mesh_axes(mesh) -> Optional[dict]:
+    if mesh is None:
+        return None
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def build(net, *, serving: Optional[dict] = None,
+          train: Optional[List[dict]] = None) -> dict:
+    """Assemble a manifest for *net*. ``serving`` / ``train`` are the
+    entry dicts :mod:`compilecache.precompile` returns."""
+    import jax
+    gc = net.conf.global_conf
+    man = {
+        "schema_version": SCHEMA_VERSION,
+        "created_unix": round(time.time(), 3),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "model": {
+            "class": type(net).__name__,
+            "num_params": int(net.num_params()),
+            "fingerprint": model_fingerprint(net),
+            "param_dtype": gc.dtype.param_dtype,
+            "compute_dtype": gc.dtype.compute_dtype,
+        },
+    }
+    if serving is not None:
+        man["serving"] = serving
+    if train:
+        man["train"] = train
+    return man
+
+
+def save(manifest: dict, path: str) -> str:
+    """Atomic write (tmp + rename); ``path`` may be a cache DIR, in
+    which case the manifest lands at ``<dir>/aot_manifest.json``."""
+    if os.path.isdir(path):
+        path = os.path.join(path, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load(path: str) -> dict:
+    if os.path.isdir(path):
+        path = os.path.join(path, MANIFEST_NAME)
+    with open(path) as f:
+        return json.load(f)
+
+
+def validate_serving(manifest: dict, net, *, row_shapes, ladder,
+                     max_batch: int, min_batch: int,
+                     compute_dtype: str, mesh=None) -> List[str]:
+    """Compare a boot-time serving configuration against the manifest.
+    Returns a list of human-readable mismatch strings — empty means the
+    precompiled artifacts cover exactly this boot. Every check compares
+    something that changes the HLO (and therefore the cache key): jax
+    version, backend, model signature, dtypes, shapes, ladder, mesh."""
+    import jax
+    mm: List[str] = []
+
+    def need(cond, msg):
+        if not cond:
+            mm.append(msg)
+
+    need(manifest.get("schema_version") == SCHEMA_VERSION,
+         f"schema_version {manifest.get('schema_version')!r} != "
+         f"{SCHEMA_VERSION}")
+    need(manifest.get("jax_version") == jax.__version__,
+         f"jax_version {manifest.get('jax_version')!r} != "
+         f"{jax.__version__!r}")
+    need(manifest.get("backend") == jax.default_backend(),
+         f"backend {manifest.get('backend')!r} != "
+         f"{jax.default_backend()!r}")
+    model = manifest.get("model") or {}
+    need(model.get("class") == type(net).__name__,
+         f"model class {model.get('class')!r} != {type(net).__name__!r}")
+    fp = model_fingerprint(net)
+    need(model.get("fingerprint") == fp,
+         f"model fingerprint {model.get('fingerprint')!r} != {fp!r}")
+    serving = manifest.get("serving")
+    if serving is None:
+        mm.append("manifest has no 'serving' entry")
+        return mm
+    want_shapes = [list(s) for s in row_shapes]
+    need(serving.get("row_shapes") == want_shapes,
+         f"row_shapes {serving.get('row_shapes')!r} != {want_shapes!r}")
+    need(serving.get("ladder") == list(ladder),
+         f"ladder {serving.get('ladder')!r} != {list(ladder)!r}")
+    need(serving.get("max_batch") == int(max_batch),
+         f"max_batch {serving.get('max_batch')!r} != {int(max_batch)}")
+    need(serving.get("min_batch") == int(min_batch),
+         f"min_batch {serving.get('min_batch')!r} != {int(min_batch)}")
+    need(serving.get("compute_dtype") == compute_dtype,
+         f"serving compute_dtype {serving.get('compute_dtype')!r} != "
+         f"{compute_dtype!r}")
+    need(serving.get("mesh_axes") == _mesh_axes(mesh),
+         f"mesh_axes {serving.get('mesh_axes')!r} != {_mesh_axes(mesh)!r}")
+    return mm

@@ -808,6 +808,8 @@ class ComputationGraph:
         values ("auto" = 8 on accelerators)."""
         if isinstance(data, (DataSet, MultiDataSet)):
             _obs_metrics.install_runtime_metrics()
+            from deeplearning4j_tpu.compilecache import ensure_configured
+            ensure_configured()  # DL4J_TPU_COMPILE_CACHE env var, if set
             ledger = _goodput.start_run("fit", net=self)
             from deeplearning4j_tpu.observability import (
                 distributed as _obs_dist)
@@ -830,6 +832,8 @@ class ComputationGraph:
         chunk = self._resolve_multi_step(multi_step)
         device_prefetch = self._resolve_device_prefetch(device_prefetch)
         _obs_metrics.install_runtime_metrics()
+        from deeplearning4j_tpu.compilecache import ensure_configured
+        ensure_configured()  # DL4J_TPU_COMPILE_CACHE env var, if set
         tracer = _get_tracer()
         ledger = _goodput.start_run("fit", net=self)
         from deeplearning4j_tpu.observability import distributed as _obs_dist

@@ -156,6 +156,16 @@ class RunReport:
     peak_flops: Optional[float] = None
     compile_count: int = 0
     compile_seconds: float = 0.0
+    # persistent-compilation-cache traffic over the run (compilecache/):
+    # warm boots show hits ~= ladder size and misses ~= 0; both 0 when
+    # no cache dir is configured
+    xla_cache_hits: int = 0
+    xla_cache_misses: int = 0
+    # cold-start attribution, annotated by the serving runtime:
+    # process start -> first successful reply, and the warm-up ladder's
+    # wall time (None outside serving / before the first reply)
+    cold_start_s: Optional[float] = None
+    warmup_s: Optional[float] = None
     device_memory_peak_bytes: Optional[float] = None
     padding: Dict[str, dict] = field(default_factory=dict)
     trace_dropped_spans: int = 0
@@ -184,6 +194,10 @@ class RunReport:
             "peak_flops": self.peak_flops,
             "compile_count": self.compile_count,
             "compile_seconds": self.compile_seconds,
+            "xla_cache_hits": self.xla_cache_hits,
+            "xla_cache_misses": self.xla_cache_misses,
+            "cold_start_s": self.cold_start_s,
+            "warmup_s": self.warmup_s,
             "device_memory_peak_bytes": self.device_memory_peak_bytes,
             "padding": self.padding,
             "trace_dropped_spans": self.trace_dropped_spans,
@@ -246,7 +260,12 @@ class EfficiencyLedger:
         self._padding: Dict[str, list] = {}  # source -> [real, padded]
         self._t0 = time.perf_counter()
         self._tracer = None
-        self._compile0 = {"count": 0, "seconds": 0.0}
+        # per-run compile/cache baseline over the process-global
+        # counters (metrics.compile_snapshot — the documented delta
+        # seam); start_run overwrites this with a live snapshot
+        self._compile0 = {"count": 0, "seconds": 0.0,
+                          "cache_hits": 0, "cache_misses": 0}
+        self._annotations: Dict[str, object] = {}
         self._dropped0 = 0
         self._closed = False
 
@@ -283,6 +302,23 @@ class EfficiencyLedger:
             else:
                 ent[0] += int(real)
                 ent[1] += int(padded)
+
+    def annotate(self, **fields) -> None:
+        """Stamp RunReport fields the runtime measures out-of-band of
+        the span stream (e.g. the server's ``warmup_s`` / ``cold_start_s``).
+        Only keys that are RunReport dataclass fields land on the
+        report; unknown keys are dropped at finish, so annotating stays
+        forward-compatible across schema versions."""
+        with self._lock:
+            self._annotations.update(fields)
+
+    def rebase_compile(self, snapshot: dict) -> None:
+        """Move the compile/cache baseline back to *snapshot* (an
+        earlier ``metrics.compile_snapshot()``), so compiles that ran
+        before ``start_run`` — e.g. the server's warm-up ladder — are
+        charged to this run's report."""
+        with self._lock:
+            self._compile0 = dict(snapshot)
 
     # ---------------------------------------------------------------- views
     @property
@@ -330,10 +366,13 @@ class EfficiencyLedger:
     def _finish(self, status: str) -> RunReport:
         from deeplearning4j_tpu.observability import metrics as _m
         wall = time.perf_counter() - self._t0
-        compile_now = _m.compile_stats()
+        compile_run = _m.compile_delta(self._compile0)
         live = self.live()
         with self._lock:
             attributed = self._attributed_s
+            known = RunReport.__dataclass_fields__
+            extra = {k: v for k, v in self._annotations.items()
+                     if k in known}
         tracer = self._tracer
         dropped = 0
         if tracer is not None:
@@ -348,7 +387,7 @@ class EfficiencyLedger:
                         "incarnation": ident.incarnation}
         except Exception:
             identity = {}
-        return RunReport(
+        report = RunReport(
             **identity,
             kind=self.kind,
             status=status,
@@ -363,13 +402,17 @@ class EfficiencyLedger:
             flops_per_second=fps,
             mfu=live["mfu"],
             peak_flops=peak,
-            compile_count=compile_now["count"] - self._compile0["count"],
-            compile_seconds=round(
-                compile_now["seconds"] - self._compile0["seconds"], 6),
+            compile_count=compile_run["count"],
+            compile_seconds=compile_run["seconds"],
+            xla_cache_hits=compile_run["cache_hits"],
+            xla_cache_misses=compile_run["cache_misses"],
             device_memory_peak_bytes=_m.memory_watermark_bytes(),
             padding=live["padding"],
             trace_dropped_spans=dropped,
         )
+        for k, v in extra.items():  # annotations override measured fields
+            setattr(report, k, v)
+        return report
 
 
 class _NullLedger:
@@ -389,6 +432,12 @@ class _NullLedger:
         pass
 
     def record_padding(self, source, real, padded):
+        pass
+
+    def annotate(self, **fields):
+        pass
+
+    def rebase_compile(self, snapshot):
         pass
 
     def live(self):
@@ -417,7 +466,7 @@ def start_run(kind: str, net=None):
     from deeplearning4j_tpu.observability import metrics as _m
     from deeplearning4j_tpu.observability.trace import get_tracer
     ledger = EfficiencyLedger(kind)
-    ledger._compile0 = _m.compile_stats()
+    ledger._compile0 = _m.compile_snapshot()
     _m.update_memory_watermark()
     tracer = get_tracer()
     ledger._tracer = tracer

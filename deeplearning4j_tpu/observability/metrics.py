@@ -24,6 +24,7 @@ suffix on counters, base units (seconds, bytes).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -414,6 +415,12 @@ _runtime_lock = threading.Lock()
 # companion dl4j_heartbeat_timestamp_seconds rendered per scrape.
 _PROCESS_START_TIME = time.time()
 _COMPILE = {"count": 0, "seconds": 0.0}
+# persistent-compilation-cache traffic (compilecache/): hits are
+# executables deserialized from the cache dir instead of compiled,
+# misses are fresh compiles written INTO the cache. Both stay 0 when no
+# cache dir is configured — jax only emits the events while a cache is
+# active, which is exactly the "is the knob on and working" signal.
+_CACHE = {"hits": 0, "misses": 0}
 _COMPILE_LISTENER_ON = False
 _RUNTIME_INSTALLED_ON: Optional[MetricsRegistry] = None
 _STEPS = {"count": 0.0, "per_sec": 0.0, "dispatch_lag_s": 0.0}
@@ -430,6 +437,18 @@ def _on_jax_event_duration(event: str, duration: float, **kw):
             _COMPILE["seconds"] += duration
 
 
+def _on_jax_event(event: str, **kw):
+    # persistent-cache traffic: '/jax/compilation_cache/cache_hits' per
+    # executable deserialized from disk, '.../cache_misses' per fresh
+    # compile written into an ACTIVE cache (no cache dir -> no events)
+    if event.endswith("/cache_hits"):
+        with _runtime_lock:
+            _CACHE["hits"] += 1
+    elif event.endswith("/cache_misses"):
+        with _runtime_lock:
+            _CACHE["misses"] += 1
+
+
 def _ensure_compile_listener():
     global _COMPILE_LISTENER_ON
     if _COMPILE_LISTENER_ON:
@@ -438,6 +457,7 @@ def _ensure_compile_listener():
         from jax import monitoring
         monitoring.register_event_duration_secs_listener(
             _on_jax_event_duration)
+        monitoring.register_event_listener(_on_jax_event)
         _COMPILE_LISTENER_ON = True
     except Exception:
         pass
@@ -447,6 +467,8 @@ def _runtime_collector() -> List[MetricFamily]:
     with _runtime_lock:
         compile_count = _COMPILE["count"]
         compile_secs = _COMPILE["seconds"]
+        cache_hits = _CACHE["hits"]
+        cache_misses = _CACHE["misses"]
         steps = dict(_STEPS)
     fams = [
         MetricFamily("dl4j_xla_compile_total", "counter",
@@ -455,6 +477,14 @@ def _runtime_collector() -> List[MetricFamily]:
         MetricFamily("dl4j_xla_compile_seconds_total", "counter",
                      "Cumulative XLA backend compile wall-clock seconds"
                      ).add(compile_secs),
+        MetricFamily("dl4j_xla_cache_hits_total", "counter",
+                     "Executables loaded from the persistent compilation "
+                     "cache instead of compiled (0 when no cache dir is "
+                     "configured — see compilecache.configure)"
+                     ).add(cache_hits),
+        MetricFamily("dl4j_xla_cache_misses_total", "counter",
+                     "Fresh compiles written into the active persistent "
+                     "compilation cache").add(cache_misses),
         MetricFamily("dl4j_fit_steps_total", "counter",
                      "Training steps dispatched by the fit loop"
                      ).add(steps["count"]),
@@ -658,6 +688,62 @@ def observe_dispatch_lag(seconds: float):
 def compile_stats() -> dict:
     with _runtime_lock:
         return dict(_COMPILE)
+
+
+def cache_stats() -> dict:
+    """Persistent-compilation-cache traffic since process start:
+    ``{"hits", "misses"}``. Both 0 unless a cache dir is configured
+    (compilecache.configure / DL4J_TPU_COMPILE_CACHE) — jax only emits
+    the hit/miss events while a cache is active."""
+    with _runtime_lock:
+        return dict(_CACHE)
+
+
+def compile_snapshot() -> dict:
+    """Baseline snapshot for :func:`compile_delta` — the documented
+    per-run seam over the process-global compile/cache counters.
+
+    ``_COMPILE`` and ``_CACHE`` are process-cumulative (jax.monitoring
+    has no unregister, and a counter that resets under a live scrape
+    would corrupt Prometheus rate()). Run-scoped numbers — what the
+    goodput ledger puts in a RunReport — must therefore be DELTAS:
+    snapshot at run start, subtract at run end. Nested or sequential
+    ledgers each take their own snapshot, so two fits in one process
+    report their own compiles, not each other's.
+
+    Taking a snapshot also installs the jax.monitoring listener: a
+    baseline is always taken BEFORE the compiles it scopes, so the
+    events land in the counters even when nothing else wired metrics."""
+    _ensure_compile_listener()
+    with _runtime_lock:
+        return {"count": _COMPILE["count"], "seconds": _COMPILE["seconds"],
+                "cache_hits": _CACHE["hits"],
+                "cache_misses": _CACHE["misses"]}
+
+
+def compile_delta(baseline: dict) -> dict:
+    """Compile/cache activity since *baseline* (a
+    :func:`compile_snapshot`). Missing baseline keys count from 0, so a
+    pre-PR-10 snapshot ({"count", "seconds"}) still subtracts clean."""
+    now = compile_snapshot()
+    return {k: (round(now[k] - baseline.get(k, 0), 6)
+                if k == "seconds" else now[k] - baseline.get(k, 0))
+            for k in now}
+
+
+def process_start_unix() -> float:
+    """Unix time this PROCESS started (kernel starttime via /proc, so
+    it predates every import) — the cold-start clock's zero. Falls back
+    to the module-import stamp where /proc is unavailable."""
+    try:
+        with open("/proc/self/stat") as f:
+            after_comm = f.read().rsplit(")", 1)[1].split()
+        ticks = float(after_comm[19])  # field 22: starttime
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        return time.time() - uptime + ticks / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return _PROCESS_START_TIME
 
 
 def _monotonic() -> float:

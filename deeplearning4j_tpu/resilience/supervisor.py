@@ -201,6 +201,10 @@ class SupervisorConfig:
     #: to flight_<instance>.json in checkpoint_dir on SIGTERM, NaN
     #: rollback, preemption and crash
     flight_recorder: bool = True
+    #: persistent XLA compilation cache dir for this run (None = the
+    #: DL4J_TPU_COMPILE_CACHE env var, if set) — a restarted replacement
+    #: process pointed at the same dir recompiles ~nothing
+    compile_cache_dir: Optional[str] = None
     #: injectable for tests (real runs sleep through backoff)
     sleep_fn: Callable[[float], None] = time.sleep
 
@@ -541,6 +545,8 @@ class TrainingSupervisor:
         resumed_from = None
 
         _obs_metrics.install_runtime_metrics()
+        from deeplearning4j_tpu.compilecache import configure as _cc_configure
+        _cc_configure(cfg.compile_cache_dir)  # falls back to env var
         # attach (and stay attached after run(): a post-run scrape still
         # reports this job's recovery counters alongside serving/compile
         # series from the same process)
@@ -666,6 +672,8 @@ class TrainingSupervisor:
         from deeplearning4j_tpu.utils.checkpoint import (
             find_latest_checkpoint)
         _obs_metrics.install_runtime_metrics()
+        from deeplearning4j_tpu.compilecache import configure as _cc_configure
+        _cc_configure(cfg.compile_cache_dir)  # falls back to env var
         self.stats.attach_to_registry(
             labels={"job": os.path.basename(
                 os.path.normpath(cfg.checkpoint_dir))})

@@ -65,6 +65,20 @@ class BatcherDeadError(RuntimeError):
     its deadline."""
 
 
+def bucket_ladder(min_batch: int, max_batch: int) -> list[int]:
+    """The full power-of-two bucket ladder (min_batch, 2*min_batch, ...,
+    capped at max_batch) — the compile footprint warm-up walks and the
+    AOT precompiler (compilecache.precompile) persists."""
+    ladder = []
+    b = max(1, int(min_batch))
+    while True:
+        ladder.append(min(b, int(max_batch)))
+        if b >= max_batch:
+            break
+        b *= 2
+    return ladder
+
+
 def next_bucket(n: int, max_batch: int, min_batch: int = 1) -> int:
     """Power-of-two bucket, capped at ``max_batch``. Requests larger than
     ``max_batch`` are CHUNKED by the caller (never compiled at raw size —
@@ -153,24 +167,31 @@ class MicroBatcher:
         return True
 
     # ---------------------------------------------------------------- warmup
-    def warm(self, row_shapes) -> list[int]:
-        """Precompile the whole bucket ladder (1, 2, 4, ..., max_batch)
-        with zero-filled inputs of the given per-input row shapes, so no
-        live request ever pays an XLA compile stall. Runs synchronously
-        (call before serving traffic). Returns the buckets warmed."""
-        ladder = []
-        b = self.min_batch
-        while True:
-            ladder.append(b)
-            if b >= self.max_batch:
-                break
-            b *= 2
-        for bucket in ladder:
+    def warm(self, row_shapes, skip=None) -> list[int]:
+        """Precompile the bucket ladder (min_batch, ..., max_batch) with
+        zero-filled inputs of the given per-input row shapes, so no live
+        request ever pays an XLA compile stall. Runs synchronously (call
+        before serving traffic).
+
+        Buckets already in ``shapes_seen`` are SKIPPED — they were
+        compiled by an earlier warm or by live traffic on this shared
+        forward (e.g. a fleet ``restart(i)`` re-warm), and re-running
+        them would only burn device time re-executing cached programs.
+        ``skip`` overrides the skip set (ReplicaSet.warm passes its
+        pre-warm snapshot so a fleet of DISTINCT forwards still warms
+        each one fully despite the shared ``shapes_seen``). Returns only
+        the buckets this call actually ran."""
+        skip = self.shapes_seen if skip is None else skip
+        compiled = []
+        for bucket in bucket_ladder(self.min_batch, self.max_batch):
+            if bucket in skip:
+                continue
             feats = [np.zeros((bucket,) + tuple(s), np.float32)
                      for s in row_shapes]
             self._forward(feats)
             self.shapes_seen.add(bucket)
-        return ladder
+            compiled.append(bucket)
+        return compiled
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -322,9 +343,11 @@ class MicroBatcher:
                     feats = [np.pad(f, [(0, bucket - rows)] + [(0, 0)]
                                     * (f.ndim - 1)) for f in feats]
                 self.shapes_seen.add(bucket)
+            t_fwd = time.perf_counter()
             with tracer.span("device_compute", bucket=bucket, rows=rows,
                              **tid_attrs):
                 out = self._forward(feats)
+            device_s = time.perf_counter() - t_fwd
         except Exception as e:
             for t in batch:
                 if self.stats is not None:
@@ -332,7 +355,10 @@ class MicroBatcher:
                 t.future.set_exception(e)
             return
         if self.stats is not None:
-            self.stats.record_batch(bucket, rows, len(batch))
+            # per-bucket device seconds feed the autotuner's measured
+            # service model (ServingStats.bucket_device_s)
+            self.stats.record_batch(bucket, rows, len(batch),
+                                    device_s=device_s)
         # padding-waste accounting: bucket - rows filler rows rode this
         # device forward (goodput ledger + dl4j_padding_waste_fraction)
         _goodput.record_padding("serving_bucket", rows, bucket - rows)

@@ -126,16 +126,22 @@ class ReplicaSet:
         forward object. Replicas sharing one model/mesh share the jit
         cache, so the ladder compiles once no matter how many replicas
         ride it; ``shapes_seen`` is shared, so the compile-count metric
-        stays flat in N."""
+        stays flat in N. Buckets already in ``shapes_seen`` before this
+        call (an earlier warm, a restart re-warm, live traffic) are
+        skipped per batcher.warm — but only against the PRE-call
+        snapshot, so when replicas carry distinct forwards each still
+        warms its own full ladder. Returns the buckets actually
+        compiled by this call (sorted, deduped across forwards)."""
+        seen0 = set(self.shapes_seen)
         warmed = set()
-        ladder = []
+        compiled: set[int] = set()
         for r in self.replicas:
             fid = id(r.batcher._forward)
             if fid in warmed:
                 continue
             warmed.add(fid)
-            ladder = r.batcher.warm(row_shapes)
-        return ladder
+            compiled.update(r.batcher.warm(row_shapes, skip=seen0))
+        return sorted(compiled)
 
     # ----------------------------------------------------------------- state
     @property

@@ -49,7 +49,14 @@ class ServingStats:
         self.errors = 0                     # 400 request failures
         self.timeouts = 0                   # 504 per-request deadline expiries
         self.batch_hist: dict[int, int] = {}  # executed bucket -> count
+        # executed bucket -> cumulative device-forward seconds: the
+        # measured per-bucket service times the trace autotuner fits
+        # its service model to (compilecache.autotune)
+        self.bucket_device_s: dict[int, float] = {}
         self.padded_rows = 0                # filler rows across forwards
+        # unix time of the first successful reply — the cold-start
+        # clock's far edge (cold_start_s = this minus process start)
+        self.first_reply_unix: float | None = None
         self.queue_depth_fn = lambda: 0     # wired by the dispatcher
         # recent executed batches as (t, rows, tickets) — the observed
         # drain rate behind the derived Retry-After. _clock is
@@ -60,12 +67,15 @@ class ServingStats:
     # ------------------------------------------------------------- recording
     def record_request(self, rows: int, latency_s: float):
         with self._lock:
+            if self.first_reply_unix is None:
+                self.first_reply_unix = time.time()
             self.requests += 1
             self.rows += int(rows)
             self._lat[self._lat_n % self._window] = float(latency_s)
             self._lat_n += 1
 
-    def record_batch(self, bucket: int, rows: int, n_tickets: int):
+    def record_batch(self, bucket: int, rows: int, n_tickets: int,
+                     device_s: float | None = None):
         with self._lock:
             self.batches += 1
             self.batch_rows += int(rows)
@@ -73,6 +83,10 @@ class ServingStats:
             self.padded_rows += max(0, int(bucket) - int(rows))
             self.batch_hist[int(bucket)] = self.batch_hist.get(int(bucket),
                                                                0) + 1
+            if device_s is not None:
+                self.bucket_device_s[int(bucket)] = (
+                    self.bucket_device_s.get(int(bucket), 0.0)
+                    + float(device_s))
             self._drain.append((self._clock(), int(rows), int(n_tickets)))
 
     # ------------------------------------------------------------ drain rate
@@ -154,6 +168,12 @@ class ServingStats:
                 "latency_window": n,
                 "batch_size_hist": {str(k): v for k, v in
                                     sorted(self.batch_hist.items())},
+                # mean device-forward ms per executed bucket — the
+                # measured service times the trace autotuner fits
+                "device_ms_by_bucket": {
+                    str(k): round(1000.0 * s / self.batch_hist[k], 3)
+                    for k, s in sorted(self.bucket_device_s.items())
+                    if self.batch_hist.get(k)},
                 # real rows (and tickets) per device forward — the
                 # cross-request coalescing signal
                 "coalesce_rows_per_batch": (

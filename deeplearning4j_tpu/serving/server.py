@@ -36,6 +36,7 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import os
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -72,7 +73,30 @@ class ModelServer:
                  max_queue: int = 1024, warmup: bool = True,
                  input_shapes=None, request_timeout_s: float = 300.0,
                  compute_dtype=None, replicas: int = 1, mesh=None,
-                 model_axis: str = "model", data_axis=None, tp_rules=None):
+                 model_axis: str = "model", data_axis=None, tp_rules=None,
+                 compile_cache_dir=None, aot_manifest=None,
+                 tuning_report=None):
+        from deeplearning4j_tpu.compilecache import cache as _ccache
+        # Cold-start engine (SERVING.md "Cold start & AOT"):
+        # - compile_cache_dir (or $DL4J_TPU_COMPILE_CACHE) activates the
+        #   persistent compilation cache, so a second boot of the same
+        #   config deserializes executables instead of compiling;
+        # - aot_manifest names (or True auto-locates, in the cache dir)
+        #   the scripts/precompile.py receipt validated at start() —
+        #   mismatch warns and falls back to lazy compile;
+        # - tuning_report loads an autotuned (max_batch, batch_window_ms)
+        #   from compilecache.autotune, overriding the defaults.
+        self.compile_cache_dir = _ccache.configure(compile_cache_dir)
+        self.aot_manifest = aot_manifest
+        self.aot_manifest_ok = None  # set by start() when a manifest loads
+        if tuning_report is not None:
+            from deeplearning4j_tpu.compilecache import autotune as _at
+            tuned = _at.load_tuned(tuning_report)
+            max_batch = tuned["max_batch"]
+            batch_window_ms = tuned["batch_window_ms"]
+            self.tuned_config = tuned
+        else:
+            self.tuned_config = None
         self.net = net
         self.host = host
         self.port = port
@@ -85,6 +109,7 @@ class ModelServer:
         self._ledger = None
         self._fleet_collector = None
         self.run_report = None  # goodput RunReport, set by stop()
+        self.warmup_s = None    # warm-up ladder wall time, set by start()
         self._is_graph = hasattr(net, "conf") and hasattr(
             net.conf, "network_inputs")
         # Serving precision contract (PRECISION.md / SERVING.md):
@@ -287,16 +312,59 @@ class ModelServer:
         return out
 
     # -------------------------------------------------------------- server
+    def _validate_aot_manifest(self, row_shapes):
+        """Check the precompile manifest (explicit path/dict, or
+        auto-located in the cache dir) against THIS boot's serving
+        config. A mismatch means the cached executables were built for
+        a different program: warn — loudly, a boot that believes it is
+        warm but compiles fresh is a silent perf regression — and fall
+        back to lazy compile. Never raises; sets ``aot_manifest_ok``."""
+        import warnings
+
+        from deeplearning4j_tpu.compilecache import manifest as _man
+        from deeplearning4j_tpu.serving.batcher import bucket_ladder
+        src = self.aot_manifest
+        if src is None and self.compile_cache_dir is not None:
+            auto = os.path.join(self.compile_cache_dir, _man.MANIFEST_NAME)
+            if os.path.exists(auto):
+                src = auto
+        if src is None:
+            return
+        try:
+            man = src if isinstance(src, dict) else _man.load(src)
+            mb = self._batcher
+            mismatches = _man.validate_serving(
+                man, self.net, row_shapes=row_shapes or (),
+                ladder=bucket_ladder(mb.min_batch, mb.max_batch),
+                max_batch=mb.max_batch, min_batch=mb.min_batch,
+                compute_dtype=self.serving_compute_dtype, mesh=self.mesh)
+        except Exception as e:
+            mismatches = [f"unreadable manifest: {type(e).__name__}: {e}"]
+        self.aot_manifest_ok = not mismatches
+        if mismatches:
+            warnings.warn(
+                "AOT precompile manifest does not match this serving "
+                "config — falling back to lazy compile (this boot pays "
+                "fresh XLA compiles): " + "; ".join(mismatches),
+                RuntimeWarning, stacklevel=3)
+
     def start(self):
         server = self
 
+        # compile baseline taken BEFORE warm-up, so the serving RunReport
+        # charges the warm-up ladder's compiles (and cache hits/misses)
+        # to this run — that delta is exactly what a warm cache zeroes
+        compile0 = _obs_metrics.compile_snapshot()
         if self.warmup:
             shapes = self._infer_row_shapes()
+            self._validate_aot_manifest(shapes)
             if shapes is not None:
+                t_warm = time.perf_counter()
                 try:
                     # hoisted: one ladder per distinct forward, however
                     # many replicas share it (fleet.warm)
                     self._fleet.warm(shapes)
+                    self.warmup_s = round(time.perf_counter() - t_warm, 6)
                 except Exception:
                     # warm-up is an optimization: a shape-inference miss
                     # must never block serving (first requests compile
@@ -434,6 +502,9 @@ class ModelServer:
             shapes_fn=lambda: self.shapes_seen)
         self._attach_fleet_collector()
         self._ledger = _goodput.start_run("serving", net=self.net)
+        self._ledger.rebase_compile(compile0)
+        if self.warmup_s is not None:
+            self._ledger.annotate(warmup_s=self.warmup_s)
         from deeplearning4j_tpu.observability import distributed as _dist
         _dist.stamp_run_marker("serving")
         import threading
@@ -505,7 +576,15 @@ class ModelServer:
             reg, collect = self._fleet_collector
             reg.unregister_collector(collect)
             self._fleet_collector = None
-        report = _goodput.end_run(getattr(self, "_ledger", None))
+        ledger = getattr(self, "_ledger", None)
+        if ledger is not None and self.stats.first_reply_unix is not None:
+            # time-to-first-reply from PROCESS start (kernel starttime):
+            # imports + model build + compiles + warm-up, the whole cold
+            # bill — not just the slice since this server object existed
+            ledger.annotate(cold_start_s=round(
+                self.stats.first_reply_unix
+                - _obs_metrics.process_start_unix(), 6))
+        report = _goodput.end_run(ledger)
         if report is not None:  # stop() is idempotent; keep the first
             self.run_report = report
 
@@ -516,7 +595,8 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
           input_shapes=None, request_timeout_s: float = 300.0,
           compute_dtype=None, replicas: int = 1, mesh=None,
           model_axis: str = "model", data_axis=None,
-          tp_rules=None) -> ModelServer:
+          tp_rules=None, compile_cache_dir=None, aot_manifest=None,
+          tuning_report=None) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
@@ -524,4 +604,7 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
                        request_timeout_s=request_timeout_s,
                        compute_dtype=compute_dtype, replicas=replicas,
                        mesh=mesh, model_axis=model_axis,
-                       data_axis=data_axis, tp_rules=tp_rules).start()
+                       data_axis=data_axis, tp_rules=tp_rules,
+                       compile_cache_dir=compile_cache_dir,
+                       aot_manifest=aot_manifest,
+                       tuning_report=tuning_report).start()

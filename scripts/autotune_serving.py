@@ -1,0 +1,68 @@
+"""Offline serving-schedule autotuner: replay a recorded serve_bench
+traffic trace against the dispatcher simulator and pick the
+(max_batch, batch_window_ms) that minimizes p99 x (1 + padding waste).
+
+Input is a ``scripts/serve_bench.py --out results.json`` file — its
+report embeds the per-request arrival trace of the highest-concurrency
+coalesced run plus the measured per-bucket device times the simulator's
+service model is fitted to (compilecache/autotune.py documents the
+dispatch semantics and the objective). Output is a tuning report the
+server boots with:
+
+    python scripts/serve_bench.py --out results.json
+    python scripts/autotune_serving.py --trace results.json \\
+        --out tuning.json
+    # then: ModelServer(net, tuning_report="tuning.json")
+    #   or: serve(net, tuning_report="tuning.json")
+
+The default config the bench ran with is always a grid point, so the
+tuned objective is <= the default's on the replayed trace by
+construction — the report's ``objective_ratio`` is the receipt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", required=True,
+                    help="serve_bench --out results file (embeds the "
+                         "arrival trace + per-bucket device times)")
+    ap.add_argument("--out", default=None,
+                    help="write the tuning report here (default: stdout "
+                         "only)")
+    ap.add_argument("--min-batch", type=int, default=2)
+    ap.add_argument("--max-batch-grid", type=int, nargs="+", default=None)
+    ap.add_argument("--window-grid-ms", type=float, nargs="+", default=None)
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.compilecache import autotune as at
+
+    try:
+        with open(args.trace) as f:
+            results = json.load(f)
+        report = at.autotune(results, min_batch=args.min_batch,
+                             max_batch_grid=args.max_batch_grid,
+                             window_grid_ms=args.window_grid_ms)
+    except (OSError, ValueError) as e:
+        print(f"autotune_serving: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2))
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

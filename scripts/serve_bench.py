@@ -95,11 +95,15 @@ class SerializedServer:
 
 # ------------------------------------------------------------ load client
 def run_load(port: int, x: np.ndarray, reference: np.ndarray,
-             concurrency: int, requests_per_client: int) -> dict:
+             concurrency: int, requests_per_client: int,
+             capture_trace: bool = False) -> dict:
     """``concurrency`` closed-loop clients, each firing
     ``requests_per_client`` single-row /predict posts over one
     persistent connection. Returns rows/sec + latency percentiles and a
-    row-exactness verdict."""
+    row-exactness verdict. ``capture_trace`` also records each request's
+    arrival offset (seconds since the start gate) so the run can be
+    replayed offline by the schedule autotuner
+    (compilecache.autotune)."""
     from deeplearning4j_tpu.observability.distributed import (TRACE_HEADER,
                                                               new_trace_id)
     lats: list[float] = []
@@ -108,6 +112,7 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
     mismatches = [0]
     # trace-context propagation receipts: ids sent, ids echoed back
     trace_ids = {"sent": 0, "echoed": 0}
+    arrivals: list = []   # (perf_counter at send, rows) when capturing
     start_gate = threading.Event()
 
     def client(tid: int):
@@ -115,6 +120,7 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
 
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
         my_lats = []
+        my_arr = []
         my_sent = my_echoed = 0
         try:
             conn.connect()
@@ -130,6 +136,8 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
                 trace_id = new_trace_id()
                 my_sent += 1
                 t0 = time.perf_counter()
+                if capture_trace:
+                    my_arr.append((t0, 1))
                 conn.request("POST", "/predict", body,
                              {"Content-Type": "application/json",
                               TRACE_HEADER: trace_id})
@@ -153,6 +161,7 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
             conn.close()
             with lock:
                 lats.extend(my_lats)
+                arrivals.extend(my_arr)
                 trace_ids["sent"] += my_sent
                 trace_ids["echoed"] += my_echoed
 
@@ -174,7 +183,12 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
         return round(1000.0 * s[min(len(s) - 1, int(round(q * (len(s) - 1))))],
                      3)
 
+    if capture_trace:
+        trace = {"concurrency": concurrency,
+                 "arrivals": sorted(
+                     [round(t - t0, 6), r] for t, r in arrivals)}
     return {
+        **({"trace": trace} if capture_trace else {}),
         "concurrency": concurrency,
         "requests": total,
         "rows_per_sec": round(total / wall, 1),
@@ -245,8 +259,15 @@ def bench_serving(concurrencies=(1, 8, 64), requests_per_client=25,
                    batch_window_ms=batch_window_ms)
     try:
         for c in concurrencies:
-            report["coalesced"][f"c{c}"] = run_load(
-                server.port, x, reference, c, requests_per_client)
+            # capture the arrival trace once, at the highest-concurrency
+            # coalesced run (the traffic shape worth autotuning for);
+            # run_load returns it inline and it moves to report["trace"]
+            res = run_load(server.port, x, reference, c,
+                           requests_per_client,
+                           capture_trace=(c == max(concurrencies)))
+            if "trace" in res:
+                report["trace"] = res.pop("trace")
+            report["coalesced"][f"c{c}"] = res
         report["metrics"] = server.metrics()
     finally:
         server.stop()
@@ -266,6 +287,7 @@ def bench_serving(concurrencies=(1, 8, 64), requests_per_client=25,
     # batcher's coalesce ratio and padding-waste fraction
     coal = [v for v in report["coalesced"].values() if "p99_ms" in v]
     if coal:
+        rr = report.get("run_report") or {}
         report["summary"] = {
             "p50_ms": min(v["p50_ms"] for v in coal),
             "p99_ms": max(v["p99_ms"] for v in coal),
@@ -275,6 +297,11 @@ def bench_serving(concurrencies=(1, 8, 64), requests_per_client=25,
             "padding_waste_fraction":
                 report["metrics"].get("padding_waste_fraction"),
             "bit_identical": all(v.get("bit_identical") for v in coal),
+            # cold-start numbers from the server's own goodput report:
+            # process start -> first successful reply, and the warm-up
+            # ladder's wall time (check_budgets gates these)
+            "cold_start_s": rr.get("cold_start_s"),
+            "warmup_s": rr.get("warmup_s"),
         }
     return report
 
