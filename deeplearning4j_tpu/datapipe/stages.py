@@ -280,9 +280,23 @@ class ShardStage(Stage):
         self._k = 0
 
     def _state(self):
-        return {"k": self._k}
+        # n/i ride along so a checkpoint records WHICH shard of HOW MANY
+        # this cursor belongs to — the elastic remap (datapipe/reshard.py)
+        # needs them to re-cut the stream for a different fleet size
+        return {"k": self._k, "n": self.num_shards, "i": self.index}
 
     def _load_state(self, state):
+        # a cursor saved for shard (i of n) is meaningless under any
+        # other (n, i): loading it silently would drop/double records.
+        # Cross-fleet resume must go through datapipe.reshard.remap_state
+        # which rewrites these fields for the new fleet first.
+        if "n" in state and (int(state["n"]) != self.num_shards
+                             or int(state["i"]) != self.index):
+            raise ValueError(
+                f"shard state was saved for shard {state['i']} of "
+                f"{state['n']}, but this pipeline shards {self.index} of "
+                f"{self.num_shards} — remap it with "
+                "deeplearning4j_tpu.datapipe.reshard.remap_state first")
         self._k = int(state["k"])
 
 

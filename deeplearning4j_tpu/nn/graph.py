@@ -232,12 +232,7 @@ class ComputationGraph:
         """Sharded training over a Mesh: data-parallel by default;
         ``model_axis`` additionally shards weights column-parallel over
         that axis (dp x tp — see parallel/tensor.py)."""
-        self._mesh = (mesh, data_axis)
-        self._train_step = None
-        self._tbptt_step = None
-        self._multi_steps = {}
-        self._apply_fns = {}
-        self._rnn_state = None
+        self._mark_meshed(mesh, data_axis, model_axis, tp_rules)
         if model_axis is not None:
             from deeplearning4j_tpu.parallel.tensor import (
                 apply_tensor_parallel)
@@ -246,6 +241,21 @@ class ComputationGraph:
         else:
             from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
             apply_mesh(self, mesh, data_axis)
+        return self
+
+    def _mark_meshed(self, mesh, data_axis: str = "data",
+                     model_axis=None, tp_rules=None):
+        """Record mesh placement + drop compiled-step caches WITHOUT
+        moving a single leaf (see MultiLayerNetwork._mark_meshed — the
+        elastic restore path in utils/checkpoint.py places leaves
+        directly into their target NamedShardings first)."""
+        self._mesh = (mesh, data_axis)
+        self._mesh_detail = {"model_axis": model_axis, "tp_rules": tp_rules}
+        self._train_step = None
+        self._tbptt_step = None
+        self._multi_steps = {}
+        self._apply_fns = {}
+        self._rnn_state = None
         return self
 
 

@@ -216,11 +216,7 @@ class MultiLayerNetwork:
         compiled step. (Replaces ParallelWrapper/Spark parameter
         averaging — SURVEY.md §2.8 — and adds the model-parallel axis the
         reference never had.)"""
-        self._mesh = (mesh, data_axis)
-        self._train_step = None
-        self._tbptt_step = None
-        self._multi_steps = {}
-        self._apply_fns = {}
+        self._mark_meshed(mesh, data_axis, model_axis, tp_rules)
         if model_axis is not None:
             from deeplearning4j_tpu.parallel.tensor import (
                 apply_tensor_parallel)
@@ -229,6 +225,21 @@ class MultiLayerNetwork:
         else:
             from deeplearning4j_tpu.parallel.data_parallel import apply_mesh
             apply_mesh(self, mesh, data_axis)
+        return self
+
+    def _mark_meshed(self, mesh, data_axis: str = "data",
+                     model_axis=None, tp_rules=None):
+        """Record mesh placement + drop compiled-step caches WITHOUT
+        moving a single leaf. The elastic restore path
+        (utils/checkpoint.py) places params/opt_state directly into
+        their target NamedShardings and then calls this, instead of the
+        replicate-then-``use_mesh`` double materialization."""
+        self._mesh = (mesh, data_axis)
+        self._mesh_detail = {"model_axis": model_axis, "tp_rules": tp_rules}
+        self._train_step = None
+        self._tbptt_step = None
+        self._multi_steps = {}
+        self._apply_fns = {}
         return self
 
     # -------------------------------------------------------------- forward

@@ -260,8 +260,25 @@ class MetricsFederation:
 
     FLEET = "fleet"
 
-    def __init__(self, stale_after_s: float = 15.0):
+    def __init__(self, stale_after_s: float = 15.0,
+                 evict_after_factor: Optional[float] = 4.0):
         self.stale_after_s = float(stale_after_s)
+        #: auto-eviction threshold as a MULTIPLE of ``stale_after_s``:
+        #: an instance whose heartbeat age exceeds
+        #: ``evict_after_factor * stale_after_s`` is dropped from the
+        #: scoreboard entirely (a shrunken fleet must not list its dead
+        #: processes forever — stale marks the wobble, eviction the
+        #: departure). None disables; ``drop()`` stays for explicit
+        #: eviction either way.
+        self.evict_after_factor = (None if evict_after_factor is None
+                                   else float(evict_after_factor))
+        if self.evict_after_factor is not None \
+                and self.evict_after_factor < 1.0:
+            raise ValueError("evict_after_factor must be >= 1 (eviction "
+                             "below the stale threshold would hide "
+                             "instances that are merely slow)")
+        #: dead instances reaped by the heartbeat-age auto-eviction
+        self.auto_evicted_total = 0
         self._lock = threading.Lock()
         #: tag -> {"snapshot", "received_at", "seq", "pushes",
         #:         "steps", "steps_changed_at"}
@@ -396,10 +413,16 @@ class MetricsFederation:
         """The scoreboard: one dict per instance with liveness (heartbeat
         + push age vs ``stale_after_s``), readiness (the pushed
         ``healthy`` flags, e.g. the serving batcher's device-thread
-        liveness), queue depth, step count and progress age."""
+        liveness), queue depth, step count and progress age.
+
+        Instances whose heartbeat age exceeds
+        ``evict_after_factor * stale_after_s`` are auto-evicted here —
+        removed from the federation, not just flagged stale — so a
+        fleet that shrank stops advertising its dead processes."""
         now = time.time()
         with self._lock:
             items = sorted(self._instances.items())
+        evict = []
         out = []
         for tag, ent in items:
             snap = ent["snapshot"]
@@ -412,6 +435,10 @@ class MetricsFederation:
             hb_age = push_age
             if hb is not None and snap_time is not None:
                 hb_age += max(0.0, float(snap_time) - float(hb))
+            if self.evict_after_factor is not None and \
+                    hb_age > self.evict_after_factor * self.stale_after_s:
+                evict.append((tag, ent["seq"]))
+                continue
             health_payload = snap.get("health") or {}
             flags = [bool(v) for k, v in health_payload.items()
                      if k.endswith("healthy") or k == "ready"]
@@ -438,6 +465,15 @@ class MetricsFederation:
                 "replicas": health_payload.get("replicas"),
             }
             out.append(row)
+        if evict:
+            with self._lock:
+                for tag, seq in evict:
+                    ent = self._instances.get(tag)
+                    # seq guard: a push that landed while we were
+                    # scoring means the instance is alive after all
+                    if ent is not None and ent["seq"] == seq:
+                        self._instances.pop(tag)
+                        self.auto_evicted_total += 1
         return out
 
     def fleet_payload(self) -> dict:
@@ -449,6 +485,8 @@ class MetricsFederation:
             "live": sum(1 for r in rows if r["live"]),
             "ready": sum(1 for r in rows if r["ready"]),
             "stale_after_s": self.stale_after_s,
+            "evict_after_factor": self.evict_after_factor,
+            "auto_evicted_total": self.auto_evicted_total,
         }
 
 

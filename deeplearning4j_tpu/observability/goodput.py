@@ -169,6 +169,10 @@ class RunReport:
     device_memory_peak_bytes: Optional[float] = None
     padding: Dict[str, dict] = field(default_factory=dict)
     trace_dropped_spans: int = 0
+    # elastic resharding (resilience/supervisor): old/new mesh + datapipe
+    # shard cursors when this run resumed a checkpoint saved under a
+    # different fleet size; None for a same-topology run
+    reshard: Optional[dict] = None
     # fleet identity (observability.distributed): which process/relaunch
     # produced this report — stamped by the ledger at finish time
     run_id: Optional[str] = None
@@ -201,6 +205,7 @@ class RunReport:
             "device_memory_peak_bytes": self.device_memory_peak_bytes,
             "padding": self.padding,
             "trace_dropped_spans": self.trace_dropped_spans,
+            "reshard": self.reshard,
             "run_id": self.run_id,
             "instance": self.instance,
             "incarnation": self.incarnation,

@@ -26,6 +26,7 @@ under GSPMD — recurrent stacks scale via sequence parallelism
 
 from __future__ import annotations
 
+import re
 from typing import Callable, Dict, Optional
 
 import jax
@@ -42,23 +43,108 @@ def default_rule(path: str, leaf, model_axis: str, axis_size: int):
     return P()
 
 
+def _split_rules(rules):
+    """Normalize the two accepted rule forms into (exact, regex) lookups.
+
+    ``rules`` is either a dict mapping EXACT keystr paths
+    (``"['layer_0']['W']"``) to PartitionSpecs — the original tp_rules
+    form — or a sequence of ``(pattern, spec)`` pairs where ``pattern``
+    is matched with ``re.search`` against the keystr path (the
+    match_partition_rules form: ``[(r"layer_\\d+.*W", P(None, "model"))]``,
+    first match wins). Dict keys are treated as exact paths, never
+    regexes, so existing bracket-heavy keys keep working unescaped."""
+    if not rules:
+        return {}, []
+    if hasattr(rules, "items"):
+        return dict(rules), []
+    return {}, [(re.compile(pat), spec) for pat, spec in rules]
+
+
+def match_partition_rules(rules, params, *, on_unmatched: str = "error"):
+    """Regex rules -> PartitionSpec pytree (the SNIPPETS.md [1] exemplar
+    mechanism). ``rules`` is a sequence of ``(regex, spec)`` pairs
+    applied with ``re.search`` against each leaf's keystr path, first
+    match wins; scalar/size-1 leaves never partition. ``on_unmatched``:
+    ``"error"`` raises naming the unmatched param path, ``"replicate"``
+    falls back to ``P()``."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_of(kp, leaf):
+        path = jax.tree_util.keystr(kp)
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for pat, spec in compiled:
+            if pat.search(path):
+                return spec
+        if on_unmatched == "replicate":
+            return P()
+        raise ValueError(f"partition rule not found for param: {path}")
+
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def unmatched_rules(rules, params) -> list:
+    """Rule entries that match NO param path — exact dict keys checked
+    by equality, regex pairs by ``re.search`` — so callers can validate
+    eagerly (a rule that silently no-ops usually means a typo'd layer
+    name, and the mis-placement only surfaces as OOM or wrong numerics
+    much later). Returns the offending keys/patterns, in rule order."""
+    exact, regex = _split_rules(rules)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in
+             jax.tree_util.tree_flatten_with_path(params)[0]]
+    missing = [key for key in exact if key not in paths]
+    missing.extend(pat.pattern for pat, _ in regex
+                   if not any(pat.search(p) for p in paths))
+    return missing
+
+
 def param_specs(params, mesh: Mesh, model_axis: str = "model",
                 rules: Optional[Dict[str, P]] = None,
                 rule: Optional[Callable] = None):
     """PartitionSpec pytree for a param tree. ``rules`` maps exact
-    keystr paths (e.g. ``"['layer_0']['W']"``) to specs; unmatched leaves
-    go through ``rule`` (default: last-axis column sharding)."""
+    keystr paths (e.g. ``"['layer_0']['W']"``) to specs, or is a
+    sequence of ``(regex, spec)`` pairs searched against the keystr
+    path (first match wins); unmatched leaves go through ``rule``
+    (default: last-axis column sharding)."""
     axis_size = mesh.shape[model_axis]
     rule = rule or default_rule
-    rules = rules or {}
+    exact, regex = _split_rules(rules)
 
     def spec_of(kp, leaf):
         path = jax.tree_util.keystr(kp)
-        if path in rules:
-            return rules[path]
+        if path in exact:
+            return exact[path]
+        for pat, spec in regex:
+            if pat.search(path):
+                return spec
         return rule(path, leaf, model_axis, axis_size)
 
     return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def opt_state_specs(opt_state, specs):
+    """PartitionSpec tree mirroring a net's opt_state: each layer's
+    slots (momentum/velocity/...) whose structure matches the layer's
+    param tree take the SAME spec tree — rules overrides included (a
+    replicated-by-rule param must not keep model-sharded momentum, or
+    sharding propagation re-shards it on the first update). Scalar slots
+    (step counters) and non-layer entries (the ``_loss_scale`` dynamic
+    loss-scaling state) replicate."""
+    ts = jax.tree_util.tree_structure
+
+    def layer_specs(ln, ln_state):
+        ln_specs = specs.get(ln) if hasattr(specs, "get") else None
+        out = {}
+        for slot, sub in ln_state.items():
+            if ln_specs is not None and ts(sub) == ts(ln_specs):
+                out[slot] = jax.tree_util.tree_map(
+                    lambda _, s: s, sub, ln_specs)
+            else:
+                out[slot] = jax.tree_util.tree_map(lambda leaf: P(), sub)
+        return out
+
+    return {ln: layer_specs(ln, st) for ln, st in opt_state.items()}
 
 
 def apply_tensor_parallel(net, mesh: Mesh, data_axis: str = "data",
@@ -85,27 +171,11 @@ def apply_tensor_parallel(net, mesh: Mesh, data_axis: str = "data",
 
     net.params = jax.tree_util.tree_map(put, net.params, specs)
 
-    # optimizer state: each layer's slots (momentum/velocity/...) mirror
-    # that layer's param tree, so they take the SAME spec tree — rules
-    # overrides included (a replicated-by-rule param must not keep
-    # model-sharded momentum, or sharding propagation re-shards it on
-    # the first update). Scalar slots (step counters) replicate.
     if net.opt_state is not None:
-        ts = jax.tree_util.tree_structure
-
-        def place_layer_opt(ln, ln_state):
-            ln_specs = specs.get(ln) if hasattr(specs, "get") else None
-            out = {}
-            for slot, sub in ln_state.items():
-                if ln_specs is not None and ts(sub) == ts(ln_specs):
-                    out[slot] = jax.tree_util.tree_map(put, sub, ln_specs)
-                else:
-                    out[slot] = jax.tree_util.tree_map(
-                        lambda leaf: put(leaf, P()), sub)
-            return out
-
-        net.opt_state = {ln: place_layer_opt(ln, st)
-                         for ln, st in net.opt_state.items()}
+        o_specs = opt_state_specs(net.opt_state, specs)
+        net.opt_state = {
+            ln: jax.tree_util.tree_map(put, st, o_specs[ln])
+            for ln, st in net.opt_state.items()}
     if net.state:
         net.state = jax.tree_util.tree_map(
             lambda leaf: replicate(mesh, leaf), net.state)

@@ -63,7 +63,7 @@ class TrainingDivergedError(RuntimeError):
 @dataclass(frozen=True)
 class RecoveryEvent:
     """One supervisor action: kind is ``resume`` | ``checkpoint`` |
-    ``retry`` | ``rollback`` | ``preempt`` | ``gc``."""
+    ``retry`` | ``rollback`` | ``preempt`` | ``gc`` | ``reshard``."""
     kind: str
     step: int
     detail: str = ""
@@ -87,6 +87,7 @@ class ResilienceStats:
         self.preemptions = 0
         self.gc_removed = 0
         self.nan_check_lag = 0
+        self.reshards = 0
 
     def bump(self, counter: str, n: int = 1):
         with self._lock:
@@ -109,6 +110,7 @@ class ResilienceStats:
                 "preemptions_total": self.preemptions,
                 "checkpoints_gc_total": self.gc_removed,
                 "nan_check_lag_max": self.nan_check_lag,
+                "reshards_total": self.reshards,
             }
 
     # ------------------------------------------- unified-registry bridge
@@ -123,6 +125,8 @@ class ResilienceStats:
         "preemptions_total": "Clean preemption exits",
         "checkpoints_gc_total": "Old/partial checkpoints removed by GC",
         "nan_check_lag_max": "Max steps the lazy NaN sentinel lagged",
+        "reshards_total": "Resumes that re-laid the run onto a "
+                          "different fleet size",
     }
 
     def metric_families(self, labels=None):
@@ -244,6 +248,9 @@ class TrainingSupervisor:
         #: state_dict rides in every checkpoint's meta.json and is
         #: restored alongside the net on resume/rollback
         self._pipeline = None
+        #: goodput ledger for the active run (reshard annotations land
+        #: on the RunReport through it)
+        self._ledger = None
         self._lr_scale0 = getattr(net, "_lr_scale", 1.0)
         #: async checkpoint writer state: at most ONE write in flight
         self._ckpt_thread: Optional[threading.Thread] = None
@@ -421,17 +428,51 @@ class TrainingSupervisor:
             self._emit("gc", current_step,
                        f"removed {removed} old/partial checkpoint(s)")
 
+    def _mesh_kwargs(self) -> dict:
+        """Restore kwargs matching the live net's placement, so a meshed
+        net's checkpoint leaves land DIRECTLY in their target
+        NamedShardings (utils/checkpoint.py schema v2) instead of a host
+        round-trip."""
+        meshed = getattr(self.net, "_mesh", None)
+        if meshed is None:
+            return {}
+        detail = getattr(self.net, "_mesh_detail", None) or {}
+        return dict(mesh=meshed[0], data_axis=meshed[1],
+                    model_axis=detail.get("model_axis"),
+                    tp_rules=detail.get("tp_rules"))
+
+    def _current_mesh_json(self):
+        meshed = getattr(self.net, "_mesh", None)
+        if meshed is None:
+            return None
+        mesh, data_axis = meshed
+        detail = getattr(self.net, "_mesh_detail", None) or {}
+        return {"axis_names": [str(a) for a in mesh.axis_names],
+                "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+                "device_count": int(mesh.size),
+                "data_axis": data_axis,
+                "model_axis": detail.get("model_axis")}
+
     def _load_into(self, path: str):
         """Restore ``path`` INTO the existing net object (params, state,
         optimizer state, step/epoch counters) so user references stay
-        valid; the compiled step is shape-compatible and is reused."""
+        valid; the compiled step is shape-compatible and is reused.
+
+        Elastic: the checkpoint may have been saved under a DIFFERENT
+        mesh/fleet size (schema-v2 layout manifest records the old
+        world). Params re-lay onto the live net's mesh automatically;
+        a datapipe shard cursor baked for the old fleet is remapped via
+        the coverage rule in datapipe/reshard.py, and the transition is
+        emitted as a ``reshard`` RecoveryEvent + stamped onto the
+        RunReport."""
         from deeplearning4j_tpu.utils.checkpoint import (
-            _net_kind, restore_computation_graph,
-            restore_multi_layer_network)
+            _net_kind, read_checkpoint_layout, read_checkpoint_meta,
+            restore_computation_graph, restore_multi_layer_network)
+        kw = self._mesh_kwargs()
         if _net_kind(self.net) == "graph":
-            restored = restore_computation_graph(path)
+            restored = restore_computation_graph(path, **kw)
         else:
-            restored = restore_multi_layer_network(path)
+            restored = restore_multi_layer_network(path, **kw)
         net = self.net
         net.params = restored.params
         net.state = restored.state
@@ -439,16 +480,49 @@ class TrainingSupervisor:
         net.iteration = restored.iteration
         net.epoch = restored.epoch
         self._last_good = path
+
+        layout = read_checkpoint_layout(path)
+        old_mesh = (layout or {}).get("mesh")
+        new_mesh = self._current_mesh_json()
+        old_n = (old_mesh or {}).get("device_count", 1)
+        new_n = (new_mesh or {}).get("device_count", 1)
+        reshard_detail = None
+        if layout is not None and old_n != new_n:
+            reshard_detail = {"from_mesh": old_mesh, "to_mesh": new_mesh,
+                              "from_process_count":
+                                  layout.get("process_count")}
+
         if self._pipeline is not None:
-            from deeplearning4j_tpu.utils.checkpoint import (
-                read_checkpoint_meta)
             meta = read_checkpoint_meta(path)
             if "datapipe" in meta:
-                self._pipeline.load_state_dict(meta["datapipe"])
+                from deeplearning4j_tpu.datapipe.reshard import (
+                    remap_for, shard_position)
+                dp_state = meta["datapipe"]
+                old_pos = shard_position(dp_state)
+                try:
+                    self._pipeline.load_state_dict(dp_state)
+                except ValueError:
+                    # shard cursor baked for another fleet size: re-cut
+                    # the stream at the coverage rule's low-water mark
+                    remapped = remap_for(self._pipeline, dp_state)
+                    self._pipeline.load_state_dict(remapped)
+                    new_pos = shard_position(remapped)
+                    reshard_detail = dict(reshard_detail or {})
+                    reshard_detail["datapipe"] = {
+                        "from": old_pos and dict(zip("nik", old_pos)),
+                        "to": new_pos and dict(zip("nik", new_pos))}
             else:
                 logger.warning(
                     "checkpoint %s carries no datapipe state; the pipeline "
                     "keeps its current position", path)
+
+        if reshard_detail is not None:
+            self._emit("reshard", net.iteration,
+                       f"re-laid onto {new_n} device(s) from a "
+                       f"{old_n}-device checkpoint at {path}",
+                       counter="reshards")
+            if self._ledger is not None:
+                self._ledger.annotate(reshard=reshard_detail)
 
     # ------------------------------------------------------------- stepping
     def request_preemption(self):
@@ -554,6 +628,7 @@ class TrainingSupervisor:
             labels={"job": os.path.basename(
                 os.path.normpath(cfg.checkpoint_dir))})
         ledger = _goodput.start_run("resilient_fit", net=net)
+        self._ledger = ledger
 
         if cfg.resume:
             latest = find_latest_checkpoint(cfg.checkpoint_dir)
@@ -678,6 +753,7 @@ class TrainingSupervisor:
             labels={"job": os.path.basename(
                 os.path.normpath(cfg.checkpoint_dir))})
         ledger = _goodput.start_run("resilient_fit", net=net)
+        self._ledger = ledger
 
         if cfg.resume:
             latest = find_latest_checkpoint(cfg.checkpoint_dir)

@@ -95,6 +95,10 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._rr = 0          # round-robin tiebreak cursor
         self.requeued = 0     # tickets resubmitted after an eviction
+        #: the width this tier was PROVISIONED at: a fleet restarted on
+        #: fewer devices keeps serving but reports degraded until a
+        #: later restart restores the original width
+        self.target_n = len(forwards)
         self.replicas: List[Replica] = [
             Replica(i, self._make_batcher(fwd))
             for i, fwd in enumerate(forwards)]
@@ -153,10 +157,24 @@ class ReplicaSet:
     def total_depth(self) -> int:
         return sum(r.depth for r in self.replicas)
 
+    @property
+    def degraded(self) -> bool:
+        """Serving on fewer replicas than the tier was provisioned with
+        (a shrunken-fleet restart) — visible on every scoreboard row."""
+        return len(self.replicas) < self.target_n
+
     def describe(self) -> list[dict]:
         with self._lock:
             self._sweep_dead_locked()
-            return [r.describe() for r in self.replicas]
+            degraded = self.degraded
+            rows = []
+            for r in self.replicas:
+                row = r.describe()
+                if degraded:
+                    row["degraded"] = True
+                    row["target_replicas"] = self.target_n
+                rows.append(row)
+            return rows
 
     def _sweep_dead_locked(self):
         # lazy eviction: a device thread that died between submissions
@@ -198,6 +216,38 @@ class ReplicaSet:
             # new batcher's queue; restore the fleet-wide total
             self.stats.queue_depth_fn = self.total_depth
         return r
+
+    def restart_fleet(self, forwards=None, *, n: Optional[int] = None,
+                      forward=None):
+        """Rebuild the whole replica tier — possibly NARROWER than it
+        was provisioned (a fleet relaunched after losing devices).
+        Existing batchers drain gracefully; the new replicas share the
+        surviving jit cache (same forward object ⇒ warm restart, no
+        second bucket ladder). The tier keeps serving with whatever it
+        gets — ``degraded`` turns true when the new width is below the
+        original ``target_n`` and every scoreboard row says so, until a
+        later ``restart_fleet`` back at full width clears it.
+
+        Pass explicit ``forwards`` (one per replica), or ``n`` (+
+        optionally a shared ``forward``; defaults to replica 0's)."""
+        if forwards is None:
+            if n is None or int(n) < 1:
+                raise ValueError("restart_fleet needs forwards or n >= 1")
+            fwd = forward if forward is not None \
+                else self.replicas[0].batcher._forward
+            forwards = [fwd] * int(n)
+        if not forwards:
+            raise ValueError("restart_fleet needs at least one replica")
+        for r in self.replicas:
+            if r.batcher.healthy:
+                r.batcher.stop()
+        with self._lock:
+            self.replicas = [Replica(i, self._make_batcher(f))
+                             for i, f in enumerate(forwards)]
+            self._rr = 0
+        if self.stats is not None:
+            self.stats.queue_depth_fn = self.total_depth
+        return self
 
     # --------------------------------------------------------------- routing
     def _pick(self) -> Optional[Replica]:

@@ -10,6 +10,17 @@ writes its own parameter shards in parallel, and restore re-shards onto
 whatever mesh the restoring run provides — a multi-host run can resume
 on a different topology.
 
+Schema v2 (elastic resharding): every save also writes a
+``layout.json`` manifest beside the tree — per-leaf partition specs as
+actually placed (params AND optimizer slots), mesh axis names/shape,
+process count/index, and the datapipe shard ``(n, i)`` cursor positions
+the supervisor recorded. Restore onto ANY target mesh places each leaf
+directly into its target ``NamedSharding`` (specs recomputed for the
+target mesh via ``param_specs``/``opt_state_specs``, ``tp_rules``
+accepted in exact-path or ``(regex, spec)`` form) — one materialization,
+no replicate-then-``use_mesh`` double hop, so a run preempted on 8
+devices resumes on 4 (or 1, or 16) with bit-identical params.
+
 Use::
 
     from deeplearning4j_tpu.utils.checkpoint import (
@@ -80,10 +91,10 @@ class CheckpointSnapshot:
     then serializes the snapshot at its leisure."""
 
     __slots__ = ("kind", "conf", "params", "state", "opt_state",
-                 "iteration", "epoch")
+                 "iteration", "epoch", "_mesh", "_mesh_detail")
 
     def __init__(self, kind, conf, params, state, opt_state, iteration,
-                 epoch):
+                 epoch, mesh=None, mesh_detail=None):
         self.kind = kind
         self.conf = conf
         self.params = params
@@ -91,6 +102,8 @@ class CheckpointSnapshot:
         self.opt_state = opt_state
         self.iteration = iteration
         self.epoch = epoch
+        self._mesh = mesh                  # (Mesh, data_axis) or None
+        self._mesh_detail = mesh_detail    # {model_axis, tp_rules} or None
 
 
 def snapshot_for_checkpoint(net) -> CheckpointSnapshot:
@@ -107,7 +120,9 @@ def snapshot_for_checkpoint(net) -> CheckpointSnapshot:
         kind=_net_kind(net), conf=net.conf,
         params=copy_tree(net.params), state=copy_tree(net.state or {}),
         opt_state=copy_tree(net.opt_state),
-        iteration=int(net.iteration), epoch=int(net.epoch))
+        iteration=int(net.iteration), epoch=int(net.epoch),
+        mesh=getattr(net, "_mesh", None),
+        mesh_detail=getattr(net, "_mesh_detail", None))
 
 
 def save_checkpoint(net, path: str, stats=None, extra_meta=None):
@@ -137,6 +152,69 @@ def save_checkpoint(net, path: str, stats=None, extra_meta=None):
     return _save_checkpoint_inner(net, path, extra_meta)
 
 
+def _leaf_spec_json(leaf):
+    """The leaf's PartitionSpec as JSON (None → replicated/unplaced;
+    axis entries are names or lists of names), or None when the leaf
+    carries no NamedSharding (host arrays, single-device placement)."""
+    spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+    if spec is None:
+        return None
+    return [list(p) if isinstance(p, tuple) else p for p in spec]
+
+
+def _tree_specs_json(tree) -> dict:
+    return {jax.tree_util.keystr(kp): _leaf_spec_json(leaf)
+            for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def _datapipe_shard_positions(extra_meta) -> list:
+    """Every ``shard`` stage's ``(n, i, k)`` cursor found in the
+    supervisor's ``datapipe`` pipeline state (nested ``upstream``
+    dicts), outermost first."""
+    out = []
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return
+        if node.get("kind") == "shard":
+            out.append({key: int(node[key]) for key in ("n", "i", "k")
+                        if key in node})
+        walk(node.get("upstream"))
+
+    if extra_meta and isinstance(extra_meta.get("datapipe"), dict):
+        walk(extra_meta["datapipe"])
+    return out
+
+
+def _layout_manifest(net, extra_meta) -> dict:
+    """The schema-v2 elastic-resharding manifest: how this checkpoint
+    was laid out when it was saved. Restore does NOT need it to re-lay
+    the tree onto a target mesh (specs are recomputed there) — it exists
+    so tooling and the supervisor can see the old world (mesh shape,
+    process count, shard cursors) and stamp old→new transitions."""
+    meshed = getattr(net, "_mesh", None)
+    detail = getattr(net, "_mesh_detail", None) or {}
+    mesh_json = None
+    if meshed is not None:
+        mesh, data_axis = meshed
+        mesh_json = {
+            "axis_names": [str(a) for a in mesh.axis_names],
+            "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+            "device_count": int(mesh.size),
+            "data_axis": data_axis,
+            "model_axis": detail.get("model_axis"),
+        }
+    return {
+        "format_version": 2,
+        "mesh": mesh_json,
+        "process_count": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+        "param_specs": _tree_specs_json(net.params),
+        "opt_specs": _tree_specs_json(net.opt_state or {}),
+        "datapipe_shards": _datapipe_shard_positions(extra_meta),
+    }
+
+
 def _save_checkpoint_inner(net, path: str, extra_meta=None):
     path = os.path.abspath(path)
     ckptr = _checkpointer()
@@ -147,12 +225,20 @@ def _save_checkpoint_inner(net, path: str, extra_meta=None):
     if _POST_COMMIT_HOOK is not None:
         _POST_COMMIT_HOOK(path)
     if jax.process_index() == 0:
+        # layout.json lands BEFORE the meta.json rename, so meta's
+        # presence still certifies the complete checkpoint (tree +
+        # layout + meta) exactly as in format 1
+        layout = _layout_manifest(net, extra_meta)
+        ltmp = os.path.join(path, ".layout.json.tmp")
+        with open(ltmp, "w") as f:
+            json.dump(layout, f, indent=1)
+        os.replace(ltmp, os.path.join(path, "layout.json"))
         meta = {
             "kind": _net_kind(net),
             "config": net.conf.to_json(),
             "iteration": int(net.iteration),
             "epoch": int(net.epoch),
-            "format_version": 1,
+            "format_version": 2,
         }
         if extra_meta:
             clash = set(extra_meta) & set(meta)
@@ -185,6 +271,17 @@ def read_checkpoint_meta(path: str) -> dict:
         return json.load(f)
 
 
+def read_checkpoint_layout(path: str):
+    """The schema-v2 ``layout.json`` manifest (per-leaf partition specs,
+    mesh axes/shape, process count, datapipe shard cursors), or None for
+    a format-1 checkpoint saved before the manifest existed."""
+    try:
+        with open(os.path.join(path, "layout.json")) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
 def is_valid_checkpoint(path: str) -> bool:
     """A complete save: the orbax tree directory AND ``meta.json`` (which
     lands via rename strictly after the tree commit, so its presence
@@ -201,18 +298,29 @@ def find_latest_checkpoint(directory: str):
     auto-resume contract is "newest checkpoint that is provably
     complete", never "newest directory". Ordering is by step number, not
     mtime: a rolled-back run may legitimately rewrite an older step
-    later."""
+    later.
+
+    Concurrent retention GC is tolerated: a step directory the listdir
+    saw but that vanishes before (or during) its meta read is skipped
+    and the scan continues to the next-newest candidate — a reaper
+    deleting old steps while a relaunch scans for the resume point must
+    never crash the relaunch."""
     if not os.path.isdir(directory):
         return None
-    best, best_step = None, -1
+    steps = []
     for name in os.listdir(directory):
         m = _STEP_DIR.match(name)
-        if m is None:
+        if m is not None:
+            steps.append((int(m.group(1)), os.path.join(directory, name)))
+    for _, path in sorted(steps, reverse=True):
+        if not is_valid_checkpoint(path):
             continue
-        path = os.path.join(directory, name)
-        if int(m.group(1)) > best_step and is_valid_checkpoint(path):
-            best, best_step = path, int(m.group(1))
-    return best
+        try:
+            read_checkpoint_meta(path)     # provably still readable
+        except (OSError, ValueError):
+            continue                        # GC won the race — next step
+        return path
+    return None
 
 
 def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data",
@@ -245,24 +353,56 @@ def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data",
         conf = MultiLayerConfiguration.from_json(meta["config"])
         net = MultiLayerNetwork(conf).init(structure_only=True)
 
-    # target structure from the (structure-only) init; restore re-shards
-    # onto the requested mesh (replicated params) or host memory
+    # target structure from the (structure-only) init; restore places
+    # every leaf DIRECTLY into its final sharding on the target mesh —
+    # the specs are recomputed for the mesh being restored onto (never
+    # read back from the save-time layout), so any topology works:
+    # saved on 8 devices, restored on 4, 1, or 16
     target = {"params": net.params, "state": net.state or {},
               "opt_state": net.opt_state}
 
-    def as_restore_type(x):
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            sharding = NamedSharding(mesh, P())
-        else:
-            # explicit local placement: falling back to the sharding
-            # recorded in the checkpoint would break cross-topology
-            # resume (saved on 8 devices, restored on 1)
-            from jax.sharding import SingleDeviceSharding
-            sharding = SingleDeviceSharding(jax.local_devices()[0])
-        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+    if tp_rules:
+        # eager rule validation (the PR 6 dtype-policy style): a rule
+        # matching no param silently no-ops today's placement and only
+        # surfaces as OOM or wrong numerics much later
+        from deeplearning4j_tpu.parallel.tensor import unmatched_rules
+        missing = unmatched_rules(tp_rules, net.params)
+        if missing:
+            raise ValueError(
+                f"tp_rules entries match no param path: {missing!r} "
+                f"(checkpoint at {path}). Paths use jax.tree_util.keystr "
+                "form, e.g. \"['layer_0']['W']\" for exact keys or a "
+                "regex searched against that string for (pattern, spec) "
+                "rules")
 
-    abstract = jax.tree_util.tree_map(as_restore_type, target)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if model_axis is not None:
+            from deeplearning4j_tpu.parallel.tensor import (
+                opt_state_specs, param_specs)
+            p_specs = param_specs(net.params, mesh, model_axis, tp_rules)
+            specs = {"params": p_specs,
+                     "state": jax.tree_util.tree_map(
+                         lambda _: P(), net.state or {}),
+                     "opt_state": opt_state_specs(net.opt_state, p_specs)}
+        else:
+            specs = jax.tree_util.tree_map(lambda _: P(), target)
+
+        def as_restore_type(x, spec):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=NamedSharding(mesh, spec))
+
+        abstract = jax.tree_util.tree_map(as_restore_type, target, specs)
+    else:
+        # explicit local placement: falling back to the sharding
+        # recorded in the checkpoint would break cross-topology
+        # resume (saved on 8 devices, restored on 1)
+        from jax.sharding import SingleDeviceSharding
+        dev = SingleDeviceSharding(jax.local_devices()[0])
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=dev),
+            target)
+
     ckptr = _checkpointer()
     tree = ckptr.restore(os.path.join(path, "tree"), abstract)
 
@@ -272,10 +412,11 @@ def _restore(path: str, expect_kind: str, mesh=None, data_axis: str = "data",
     net.iteration = int(meta["iteration"])
     net.epoch = int(meta["epoch"])
     if mesh is not None:
-        # model_axis/tp_rules must ride through or a dp x tp net silently
-        # resumes fully replicated (and may not even fit)
-        net.use_mesh(mesh, data_axis, model_axis=model_axis,
-                     tp_rules=tp_rules)
+        # leaves are already in their final shardings — just record the
+        # placement (model_axis/tp_rules must ride through or a dp x tp
+        # net silently resumes fully replicated and may not even fit)
+        net._mark_meshed(mesh, data_axis, model_axis=model_axis,
+                         tp_rules=tp_rules)
     return net
 
 

@@ -1,0 +1,315 @@
+"""Chaos resharding demo: preempt a run mid-epoch on an 8-device mesh,
+resume it on FOUR devices, and prove nothing was lost in translation.
+
+Drives the elastic-restore path (schema-v2 layout manifest +
+``restore_*(mesh=...)`` re-layout + datapipe coverage remap) end to end:
+
+1. **Old world** — a dp×tp-meshed MLP trains under the supervisor on an
+   8-device ``(data=2, model=4)`` mesh, reading shard ``(n=2, i=0)`` of
+   the record stream (one of two simulated hosts; host 1's consumption
+   is replayed for the coverage ledger). A fault-injected preemption
+   stops it mid-epoch with a clean checkpoint.
+2. **Restore fidelity** — the checkpoint restores onto a 4-device
+   ``(data=2, model=2)`` mesh, each leaf landing DIRECTLY in its target
+   ``NamedSharding``; every param and optimizer-slot array must be
+   bit-identical to the moment of preemption, and the restore span's
+   fresh-compile count (the PR-10 ``compile_snapshot`` seam) is
+   recorded and budget-gated.
+3. **New world** — a fresh supervisor + net built for the 4-device mesh
+   resumes from the same directory: the shard cursor baked for the
+   2-host fleet is remapped by the coverage rule in
+   ``datapipe/reshard.py``, a ``reshard`` RecoveryEvent fires, and the
+   RunReport carries the old→new mesh stamp.
+4. **Verdict** — (a) the records consumed across old shards + resumed
+   run tile the epoch exactly (disjoint, covering, no record dropped or
+   doubled); (b) the resumed run's final params are bit-identical to a
+   control that restores the same checkpoint and replays the same
+   remainder by hand (``np.testing.assert_array_equal``, not allclose).
+5. **Serving tier** — the trained fleet restarts on half its replicas
+   via ``ReplicaSet.restart_fleet``: still serving, scoreboard rows
+   flagged ``degraded``.
+
+Run: ``python scripts/chaos_reshard.py --out RESHARD_r01.json`` (CPU,
+simulated devices, ~30s). The slow pytest wrapper is
+``tests/test_reshard.py::test_chaos_reshard_script_slow``; the artifact
+is gated by ``scripts/check_budgets.py --bench`` against the
+``reshard`` section of BUDGETS.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 8 simulated devices must exist before jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # F64 policy: bit-exact verdicts
+
+N_RECORDS = 64
+BATCH = 4
+PREEMPT_STEP = 3
+
+
+def build_net(seed):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    f64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .dtype(f64).list()
+            .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_mesh(n_devices, model_dim):
+    devs = np.array(jax.devices()[:n_devices]).reshape(
+        n_devices // model_dim, model_dim)
+    return jax.sharding.Mesh(devs, ("data", "model"))
+
+
+def build_data(seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N_RECORDS, 12))
+    x[:, 0] = np.arange(N_RECORDS)  # record id rides in feature column 0
+    y = np.eye(4)[rng.integers(0, 4, N_RECORDS)]
+    return x, y
+
+
+def build_pipeline(x, y, num_shards, index, tracker):
+    """shard -> map(track record ids) -> batch. The tracking map is a
+    1:1 stage (workers=0, no inflight), so the coverage remap accepts
+    it; it logs each record id the moment a batch pulls it."""
+    from deeplearning4j_tpu import datapipe
+
+    def track(rec):
+        tracker.append(int(round(float(rec[0][0]))))
+        return rec
+
+    return (datapipe.from_arrays(x, y).shard(num_shards, index)
+            .map(track).batch(BATCH))
+
+
+def flat_params(net):
+    return {(n, k): np.asarray(v) for n, sub in net.params.items()
+            for k, v in sub.items()}
+
+
+def flat_opt(net):
+    leaves, _ = jax.tree_util.tree_flatten(net.opt_state)
+    return [np.asarray(v) for v in leaves]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--checkpoint-every", type=int, default=5)
+    ap.add_argument("--dir", default=None,
+                    help="checkpoint directory (default: fresh tempdir)")
+    ap.add_argument("--out", default=None,
+                    help="write the receipt JSON here (RESHARD_r01.json)")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.observability.metrics import (compile_delta,
+                                                          compile_snapshot)
+    from deeplearning4j_tpu.resilience import (FaultInjector,
+                                               SupervisorConfig,
+                                               TrainingSupervisor)
+    from deeplearning4j_tpu.utils.checkpoint import (
+        find_latest_checkpoint, read_checkpoint_layout, read_checkpoint_meta,
+        restore_multi_layer_network)
+
+    ckpt_dir = args.dir or tempfile.mkdtemp(prefix="chaos_reshard_")
+    if args.dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    x, y = build_data(args.seed)
+    mesh8 = make_mesh(8, 4)
+    mesh4 = make_mesh(4, 2)
+
+    def supervisor(net, injector=None):
+        return TrainingSupervisor(
+            net, SupervisorConfig(checkpoint_dir=ckpt_dir,
+                                  checkpoint_every_steps=args.checkpoint_every,
+                                  backoff_initial_s=0.01,
+                                  handle_sigterm=False),
+            injector=injector)
+
+    # -------------------------------------- 1. old world: 8 devices, 2 hosts
+    print(f"[old] 8-device (data=2, model=4) mesh, shard (2, 0), "
+          f"preempt at step {PREEMPT_STEP}, dir {ckpt_dir}")
+    net_a = build_net(args.seed).use_mesh(mesh8, model_axis="model")
+    seen_host0 = []
+    pipe_a = build_pipeline(x, y, 2, 0, seen_host0)
+    injector = FaultInjector().preempt_at_step(PREEMPT_STEP)
+    with injector.installed():
+        res_a = supervisor(net_a, injector).fit_pipeline(pipe_a, epochs=1)
+    assert res_a.status == "preempted", res_a.status
+    steps_done = res_a.final_step   # the armed step finishes in flight
+    params_at_preempt = flat_params(net_a)
+    opt_at_preempt = flat_opt(net_a)
+    print(f"[old] preempted at step {steps_done}; host 0 consumed "
+          f"{len(seen_host0)} records")
+
+    # the second simulated host ran the same number of lockstep steps on
+    # shard (2, 1) — replay its consumption for the coverage ledger
+    seen_host1 = []
+    pipe_phantom = build_pipeline(x, y, 2, 1, seen_host1)
+    for _ in itertools.islice(iter(pipe_phantom), steps_done):
+        pass
+
+    latest = find_latest_checkpoint(ckpt_dir)
+    assert latest is not None
+    layout = read_checkpoint_layout(latest)
+    assert layout and layout["mesh"]["device_count"] == 8, layout
+
+    # ------------------------------ 2. restore fidelity onto the 4-dev mesh
+    snap = compile_snapshot()
+    net_r = restore_multi_layer_network(latest, mesh=mesh4,
+                                        model_axis="model")
+    delta = compile_delta(snap)
+    restore_fresh = int(delta["count"])
+    bit_identical = 1
+    pr = flat_params(net_r)
+    assert pr.keys() == params_at_preempt.keys()
+    for key in pr:
+        np.testing.assert_array_equal(
+            pr[key], params_at_preempt[key],
+            err_msg=f"restored param {key} diverged")
+    for got, want in zip(flat_opt(net_r), opt_at_preempt):
+        np.testing.assert_array_equal(got, want)
+    for sub in net_r.params.values():
+        for v in sub.values():
+            assert getattr(v.sharding, "mesh", None) is not None
+    print(f"[restore] {len(pr)} params + {len(opt_at_preempt)} optimizer "
+          f"slots bit-identical on the 4-device mesh "
+          f"({restore_fresh} fresh compiles during restore)")
+
+    # ------------------- 2b. trajectory control: hand-replayed remainder
+    # (restored now, before the resumed run's retention GC collects the
+    # preemption step directory)
+    from deeplearning4j_tpu.datapipe.reshard import remap_for
+    net_c = restore_multi_layer_network(latest, mesh=mesh4,
+                                        model_axis="model")
+    seen_control = []
+    pipe_c = build_pipeline(x, y, 1, 0, seen_control)
+    pipe_c.load_state_dict(
+        remap_for(pipe_c, read_checkpoint_meta(latest)["datapipe"]))
+    for ds in pipe_c.stream(1):
+        net_c.fit_batch(ds)
+
+    # --------------------------- 3. new world: resume on 4 devices, 1 host
+    print("[new] 4-device (data=2, model=2) mesh, lone survivor "
+          "shard (1, 0)")
+    net_b = build_net(args.seed).use_mesh(mesh4, model_axis="model")
+    seen_resumed = []
+    pipe_b = build_pipeline(x, y, 1, 0, seen_resumed)
+    res_b = supervisor(net_b).fit_pipeline(pipe_b, epochs=1)
+    assert res_b.status == "completed", res_b.status
+    assert res_b.resumed_from == latest, (res_b.resumed_from, latest)
+    reshard_events = [e for e in res_b.events if e.kind == "reshard"]
+    assert reshard_events, [e.kind for e in res_b.events]
+    assert res_b.stats.get("reshards_total", 0) >= 1, res_b.stats
+    report_stamp = getattr(res_b.report, "reshard", None)
+    assert report_stamp and report_stamp["from_mesh"]["device_count"] == 8
+    assert report_stamp["to_mesh"]["device_count"] == 4
+    assert report_stamp["datapipe"]["from"]["n"] == 2
+    assert report_stamp["datapipe"]["to"]["n"] == 1
+    print(f"[new] completed at step {res_b.final_step}; reshard event: "
+          f"'{reshard_events[0].detail}'")
+
+    # ------------------------------------------- 4a. datapipe exactness
+    low_water = steps_done * BATCH * 2   # global records consumed
+    assert seen_resumed == list(range(low_water, N_RECORDS)), (
+        seen_resumed[:4], low_water)
+    ledger = sorted(seen_host0 + seen_host1 + seen_resumed)
+    assert ledger == list(range(N_RECORDS)), "records dropped or doubled"
+    datapipe_exact = 1
+    expected_final = steps_done + (N_RECORDS - low_water) // BATCH
+    assert res_b.final_step == expected_final, (res_b.final_step,
+                                                expected_final)
+    print(f"[data] epoch tiled exactly: {len(seen_host0)} + "
+          f"{len(seen_host1)} + {len(seen_resumed)} = {N_RECORDS} records, "
+          f"low-water mark {low_water}")
+
+    # -------------------- 4b. verdict on the hand-replayed control (2b)
+    assert seen_control == seen_resumed
+    pb, pc = flat_params(net_b), flat_params(net_c)
+    for key in pb:
+        np.testing.assert_array_equal(
+            pb[key], pc[key],
+            err_msg=f"resumed param {key} diverged from control replay")
+    print(f"[trajectory] resumed run bit-identical to the control replay "
+          f"({len(pb)} parameter arrays)")
+
+    # ----------------------------- 5. serving fleet: restart on half width
+    from deeplearning4j_tpu.serving import ReplicaSet
+    fwd = lambda feats: np.asarray(feats[0], np.float64) * 2.0  # noqa: E731
+    rs = ReplicaSet(fwd, 2, max_queue=64, batch_window_ms=0.0)
+    rs.submit([np.ones(4)]).result(timeout=10)
+    rs.restart_fleet(n=1)
+    assert rs.degraded
+    rows = rs.describe()
+    assert len(rows) == 1 and rows[0]["degraded"] \
+        and rows[0]["target_replicas"] == 2, rows
+    out = rs.submit([np.ones(4)]).result(timeout=10)
+    np.testing.assert_array_equal(np.asarray(out), np.full(4, 2.0))
+    rs.stop()
+    fleet_degraded_serving = 1
+    print("[fleet] restarted on 1 of 2 replicas: still serving, "
+          "scoreboard row flagged degraded")
+
+    # ------------------------------------------------------------ receipt
+    receipt = {
+        "config": "reshard",
+        "created_unix": round(time.time(), 2),
+        "devices_before": 8, "devices_after": 4,
+        "shards_before": 2, "shards_after": 1,
+        "preempt_step": steps_done, "final_step": res_b.final_step,
+        "records": N_RECORDS, "low_water_record": low_water,
+        "bit_identical": bit_identical,
+        "datapipe_exact": datapipe_exact,
+        "restore_fresh_compiles": restore_fresh,
+        "reshard_events": len(reshard_events),
+        "fleet_degraded_serving": fleet_degraded_serving,
+        "detail": {
+            "checkpoint": os.path.basename(latest),
+            "restore_compile_delta": delta,
+            "reshard_event": reshard_events[0].detail,
+            "report_stamp": report_stamp,
+        },
+    }
+    print("\n[verdict] PASS — 8-device run resumed on 4 devices: params "
+          "bit-identical, epoch coverage exact, "
+          f"{restore_fresh} restore compiles")
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(receipt, fh, indent=1, sort_keys=False)
+        print(f"[receipt] {args.out}")
+    else:
+        print(json.dumps(receipt, indent=1))
+    if not args.dir:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

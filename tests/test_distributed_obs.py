@@ -337,7 +337,9 @@ def test_federation_local_registry_folds_in(fresh_obs):
 # ------------------------------------------------------- health scoreboard
 
 def test_health_scoreboard_staleness_and_readiness():
-    fed = MetricsFederation(stale_after_s=15.0)
+    # evict_after_factor=None: this test pins the stale-but-listed
+    # semantics; auto-eviction has its own test below
+    fed = MetricsFederation(stale_after_s=15.0, evict_after_factor=None)
     now = time.time()
     hb = [_fam("dl4j_heartbeat_timestamp_seconds", "gauge",
                [({}, "", now)])]
@@ -361,6 +363,34 @@ def test_health_scoreboard_staleness_and_readiness():
     payload = fed.fleet_payload()
     assert payload["live"] == 2 and payload["ready"] == 1
     assert payload["stale_after_s"] == 15.0
+
+
+def test_health_auto_evicts_dead_instances():
+    """An instance whose heartbeat age blows past
+    ``evict_after_factor * stale_after_s`` vanishes from the scoreboard
+    entirely (a shrunken fleet must not list dead processes forever);
+    one merely past ``stale_after_s`` stays, flagged not-live."""
+    now = time.time()
+    fed = MetricsFederation(stale_after_s=10.0, evict_after_factor=4.0)
+    hb = lambda age: [_fam(  # noqa: E731
+        "dl4j_heartbeat_timestamp_seconds", "gauge", [({}, "", now - age)])]
+    fed.ingest(_wire_snapshot("fresh", hb(0)))
+    fed.ingest(_wire_snapshot("wobbling", hb(20)))   # stale, not dead
+    fed.ingest(_wire_snapshot("departed", hb(120)))  # past 4 x 10s
+    rows = {r["instance"]: r for r in fed.health()}
+    assert set(rows) == {"fresh", "wobbling"}
+    assert rows["fresh"]["live"] and not rows["wobbling"]["live"]
+    assert fed.instance_tags() == ["fresh", "wobbling"]
+    assert fed.auto_evicted_total == 1
+    payload = fed.fleet_payload()
+    assert payload["auto_evicted_total"] == 1
+    assert payload["evict_after_factor"] == 4.0
+    # a fresh push re-admits the departed instance (it came back)
+    fed.ingest(_wire_snapshot("departed", hb(0)))
+    assert "departed" in {r["instance"] for r in fed.health()}
+    # explicit drop() still works alongside auto-eviction
+    fed.drop("departed")
+    assert "departed" not in fed.instance_tags()
 
 
 def test_health_progress_age_tracks_step_changes():

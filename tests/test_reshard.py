@@ -1,0 +1,325 @@
+"""Elastic resharding tests: checkpoints written under one mesh / fleet
+size restore under any other.
+
+Pins the schema-v2 contract end to end:
+
+- **Mesh sweep** — save under mesh A, restore under mesh B, for device
+  counts {1, 2, 4, 8} in every layout the stack supports (pure dp, pure
+  tp, dp×tp): gathered params and optimizer slots are bit-identical,
+  and every leaf lands directly in the target mesh's ``NamedSharding``.
+- **Step equivalence** — the direct-sharded restore takes the SAME next
+  training step as the legacy host-restore-then-``use_mesh`` path
+  (same target mesh ⇒ same reduction order ⇒ bit-identical).
+- **Datapipe coverage** — remapping a shard cursor from an n_old-host
+  fleet to an n_new-host fleet leaves the union of already-consumed and
+  still-to-come records exactly the epoch: disjoint, covering, no
+  record dropped or doubled.
+- **Retention race** — ``find_latest_checkpoint`` tolerates a step
+  directory deleted by retention GC between its listdir and its meta
+  read.
+- **Operator errors** — a ``tp_rules`` entry matching no param path
+  raises ValueError naming the dead rule.
+- **Receipt (slow)** — the full ``scripts/chaos_reshard.py`` scenario,
+  gated against the ``reshard`` section of BUDGETS.json.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+pytest.importorskip("orbax.checkpoint")
+
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu import datapipe
+from deeplearning4j_tpu.datapipe.reshard import remap_state, shard_position
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.parallel import make_mesh
+from deeplearning4j_tpu.utils.checkpoint import (
+    find_latest_checkpoint, read_checkpoint_layout,
+    restore_multi_layer_network, save_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mln(seed=7):
+    f64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .dtype(f64).list()
+            .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(seed=3, n=16):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(seed)
+    return DataSet(rng.normal(size=(n, 12)),
+                   np.eye(4)[rng.integers(0, 4, n)])
+
+
+def _flat(net):
+    return {(ln, k): np.asarray(v) for ln, sub in net.params.items()
+            for k, v in sub.items()}
+
+
+def _flat_opt(net):
+    return [np.asarray(v) for v in jax.tree_util.tree_leaves(net.opt_state)]
+
+
+# Every (device_count, layout) the stack supports on the 8-device test
+# fixture. "dp" = data axis only; "tp" = all devices on the model axis;
+# "dpxtp" = both axes. Model-axis sizes all divide n_out=16.
+MESH_CONFIGS = {
+    "host": None,                              # no mesh at all
+    "dp1": {"data": 1}, "dp2": {"data": 2},
+    "dp4": {"data": 4}, "dp8": {"data": 8},
+    "tp1": {"data": 1, "model": 1}, "tp2": {"data": 1, "model": 2},
+    "tp4": {"data": 1, "model": 4}, "tp8": {"data": 1, "model": 8},
+    "dpxtp2": {"data": 1, "model": 2}, "dpxtp4": {"data": 2, "model": 2},
+    "dpxtp8": {"data": 2, "model": 4},
+}
+
+
+def _meshed(net, name):
+    axes = MESH_CONFIGS[name]
+    if axes is None:
+        return net
+    model_axis = "model" if "model" in axes else None
+    return net.use_mesh(make_mesh(axes), model_axis=model_axis)
+
+
+def _restore_kwargs(name):
+    axes = MESH_CONFIGS[name]
+    if axes is None:
+        return {}
+    return {"mesh": make_mesh(axes),
+            "model_axis": "model" if "model" in axes else None}
+
+
+# Cover every config as a SOURCE and as a TARGET at least once (cyclic
+# pairing), plus the canonical shrink/grow/cross-layout transitions.
+_NAMES = list(MESH_CONFIGS)
+SWEEP_PAIRS = sorted(set(
+    list(zip(_NAMES, _NAMES[1:] + _NAMES[:1]))
+    + [("dpxtp8", "dpxtp4"),   # the chaos_reshard.py shrink
+       ("tp8", "dp1"), ("dp1", "tp8"),
+       ("dp8", "dpxtp4"), ("tp4", "tp8"), ("dpxtp4", "host")]))
+
+
+@pytest.mark.parametrize("src,dst", SWEEP_PAIRS,
+                         ids=[f"{a}->{b}" for a, b in SWEEP_PAIRS])
+def test_reshard_sweep_bit_identical(tmp_path, src, dst):
+    """Save under mesh A, restore under mesh B: gathered params and
+    optimizer slots bit-identical, leaves laid out on B."""
+    net = _meshed(_mln(), src)
+    net.fit_batch(_batch())          # non-trivial opt state + step count
+    ref_p, ref_o = _flat(net), _flat_opt(net)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(net, path)
+
+    got = restore_multi_layer_network(path, **_restore_kwargs(dst))
+    assert got.iteration == net.iteration
+    gp = _flat(got)
+    assert gp.keys() == ref_p.keys()
+    for key in gp:
+        np.testing.assert_array_equal(gp[key], ref_p[key],
+                                      err_msg=f"param {key} ({src}->{dst})")
+    for a, b in zip(_flat_opt(got), ref_o):
+        np.testing.assert_array_equal(a, b)
+
+    axes = MESH_CONFIGS[dst]
+    if axes is not None:
+        mesh_sizes = {int(v.sharding.mesh.size)
+                      for sub in got.params.values() for v in sub.values()
+                      if hasattr(v.sharding, "mesh")}
+        assert mesh_sizes == {int(np.prod(list(axes.values())))}
+        if "model" in axes and axes["model"] > 1:
+            w = got.params["layer_0"]["W"]
+            assert w.sharding.spec == P(None, "model"), w.sharding.spec
+
+
+def test_reshard_layout_manifest(tmp_path):
+    """The schema-v2 layout manifest beside the tree records the saving
+    world: mesh axes/shape, process count, per-leaf partition specs."""
+    net = _meshed(_mln(), "dpxtp8")
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(net, path)
+    layout = read_checkpoint_layout(path)
+    assert layout["format_version"] == 2
+    assert layout["mesh"]["device_count"] == 8
+    assert layout["mesh"]["axis_names"] == ["data", "model"]
+    assert layout["mesh"]["shape"] == [2, 4]
+    assert layout["process_count"] == 1
+    assert layout["param_specs"]["['layer_0']['W']"] == [None, "model"]
+    # host-saved checkpoints still carry a manifest (mesh: null)
+    net2 = _mln()
+    path2 = str(tmp_path / "ckpt_host")
+    save_checkpoint(net2, path2)
+    assert read_checkpoint_layout(path2)["mesh"] is None
+
+
+@pytest.mark.parametrize("dst", ["dp4", "tp4", "dpxtp8"])
+def test_reshard_next_step_matches_legacy_path(tmp_path, dst):
+    """The direct-to-NamedSharding restore must take the same next
+    training step as host-restore followed by use_mesh (same mesh, same
+    reduction order — bit-identical, not allclose)."""
+    net = _meshed(_mln(), "dpxtp4")
+    net.fit_batch(_batch(seed=1))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(net, path)
+
+    direct = restore_multi_layer_network(path, **_restore_kwargs(dst))
+    legacy = _meshed(restore_multi_layer_network(path), dst)
+    ds = _batch(seed=2)
+    direct.fit_batch(ds)
+    legacy.fit_batch(ds)
+    dp, lp = _flat(direct), _flat(legacy)
+    for key in dp:
+        np.testing.assert_array_equal(
+            dp[key], lp[key], err_msg=f"step diverged on {key} -> {dst}")
+
+
+def test_restore_unmatched_tp_rule_raises(tmp_path):
+    """A tp_rules entry that matches no param path is an operator error:
+    restore refuses, naming the dead rule."""
+    net = _mln()
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(net, path)
+    with pytest.raises(ValueError, match="no_such_layer"):
+        restore_multi_layer_network(
+            path, mesh=make_mesh({"data": 1, "model": 2}),
+            model_axis="model",
+            tp_rules=[(r"no_such_layer", P(None, "model"))])
+
+
+# ----------------------------------------------------------- datapipe remap
+def _pipe(n, i, tracker, records=60, bs=4):
+    x = np.zeros((records, 3))
+    x[:, 0] = np.arange(records)
+    y = np.eye(2)[np.arange(records) % 2]
+    return (datapipe.from_arrays(x, y).shard(n, i)
+            .map(lambda r: (tracker.append(int(r[0][0])), r)[1]).batch(bs))
+
+
+@pytest.mark.parametrize("n_old,n_new,steps", [
+    (8, 4, 3), (4, 8, 2), (8, 1, 3), (1, 4, 5), (2, 2, 4), (3, 5, 2),
+    (8, 4, 0),
+], ids=lambda v: str(v))
+def test_shard_remap_disjoint_and_covering(n_old, n_new, steps):
+    """Coverage property: after the lockstep fleet consumed `steps`
+    batches per shard under n_old shards, the remapped n_new shards tile
+    the REMAINDER of the epoch exactly — every record consumed exactly
+    once across old and new worlds."""
+    records, bs = 120, 4
+    consumed = []
+    state = None
+    for i in range(n_old):
+        seen = []
+        p = _pipe(n_old, i, seen, records, bs)
+        it = iter(p)
+        for _ in range(steps):
+            next(it)
+        it.close()
+        consumed += seen
+        if i == 0:
+            state = p.state_dict()
+
+    remainder = []
+    for j in range(n_new):
+        seen = []
+        q = _pipe(n_new, j, seen, records, bs)
+        q.load_state_dict(remap_state(state, n_new, j))
+        for _ in q.stream(1):
+            pass
+        remainder += seen
+
+    assert sorted(consumed + remainder) == list(range(records)), (
+        f"{n_old}->{n_new}@{steps}: records dropped or doubled")
+    if n_old != n_new:   # identity remap keeps the raw scan counter
+        low = steps * bs * n_old
+        assert shard_position(remap_state(state, n_new, 0))[2] == low
+
+
+def test_shard_remap_identity_keeps_buffers():
+    """Same-(n, i) load is NOT a reshard: remap returns the state
+    untouched (partial-batch buffers and all)."""
+    tracker = []
+    p = _pipe(2, 1, tracker, records=30, bs=4)
+    it = iter(p)
+    next(it)
+    it.close()
+    state = p.state_dict()
+    assert remap_state(state, 2, 1) == state
+
+
+def test_cross_fleet_load_without_remap_raises():
+    """Loading an n_old-fleet cursor straight into an n_new-fleet
+    pipeline fails loudly and points at the remap helper."""
+    t1, t2 = [], []
+    p = _pipe(4, 0, t1)
+    it = iter(p)
+    next(it)
+    it.close()
+    q = _pipe(2, 0, t2)
+    with pytest.raises(ValueError, match="remap_state"):
+        q.load_state_dict(p.state_dict())
+
+
+# ------------------------------------------------------------ retention race
+def test_find_latest_tolerates_gc_race(tmp_path, monkeypatch):
+    """Retention GC may delete a step directory between
+    find_latest_checkpoint's listdir and its meta read: the scan must
+    skip the corpse and fall back to the next newest valid step."""
+    from deeplearning4j_tpu.utils import checkpoint as ckpt
+    net = _mln()
+    for step in (5, 10):
+        net.iteration = step
+        save_checkpoint(net, str(tmp_path / f"step_{step}"))
+
+    real_read = ckpt.read_checkpoint_meta
+    killed = []
+
+    def racing_read(path):
+        if path.endswith("step_10") and not killed:
+            killed.append(path)
+            shutil.rmtree(path)     # GC wins the race mid-scan
+        return real_read(path)
+
+    monkeypatch.setattr(ckpt, "read_checkpoint_meta", racing_read)
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert latest == str(tmp_path / "step_5")
+    assert killed, "race hook never fired"
+
+
+# ------------------------------------------------------------------- receipt
+@pytest.mark.slow
+def test_chaos_reshard_script_slow(tmp_path):
+    """The full 8→4 device chaos scenario, then the budget gate — what
+    CI publishes as RESHARD_r01.json."""
+    out = str(tmp_path / "RESHARD.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)      # the script sets its own device count
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_reshard.py"),
+         "--out", out],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert run.returncode == 0, run.stdout + run.stderr
+    receipt = json.load(open(out))
+    assert receipt["bit_identical"] == 1 and receipt["datapipe_exact"] == 1
+    gate = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_budgets.py"),
+         "--bench", out],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
