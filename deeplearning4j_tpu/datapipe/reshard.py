@@ -51,7 +51,8 @@ from __future__ import annotations
 
 import copy
 
-__all__ = ["remap_state", "remap_for", "shard_position"]
+__all__ = ["remap_state", "remap_for", "shard_position",
+           "low_water_mark"]
 
 # stages that may sit downstream of the shard: state key holding their
 # buffered-record payload (cleared by the remap, counted into b)
@@ -78,6 +79,26 @@ def shard_position(state: dict):
                 return None
             return (int(node["n"]), int(node["i"]), int(node["k"]))
     return None
+
+
+def low_water_mark(state: dict):
+    """The global record index ``G`` at which an elastic remap of this
+    checkpointed state would re-cut the stream (see the coverage rule in
+    the module docstring: ``G = (r - b) * n_old`` — every upstream
+    position ``< G`` was consumed by exactly one old shard, nothing
+    ``>= G`` by anyone). None when the pipeline has no shard stage.
+
+    This is the tiling oracle chaos drivers assert against: a resumed
+    fleet of ANY size must consume exactly the positions ``[G, N)``."""
+    pos = shard_position(state)
+    if pos is None:
+        return None
+    n_old, i_old, k_old = pos
+    chain = _chain(state)
+    shard = next(n for n in chain if n.get("kind") == "shard")
+    b = sum(_buffered_count(n) for n in chain[:chain.index(shard)])
+    r = max(0, -(-(k_old - i_old) // n_old))   # ceil over ints
+    return max(0, (r - b)) * n_old
 
 
 def _buffered_count(node: dict) -> int:

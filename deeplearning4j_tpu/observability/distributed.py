@@ -63,6 +63,7 @@ __all__ = [
     "ProcessIdentity", "get_identity", "set_identity", "reset_identity",
     "bump_incarnation", "new_trace_id", "stamp_run_marker", "TRACE_HEADER",
     "export_snapshot", "MetricsFederation", "SNAPSHOT_SCHEMA_VERSION",
+    "rank_suffix", "push_snapshot",
 ]
 
 #: the header /predict accepts and echoes; serve_bench generates them
@@ -166,6 +167,24 @@ def bump_incarnation() -> ProcessIdentity:
                         start_time=time.time())
 
 
+def rank_suffix() -> str:
+    """Per-rank artifact disambiguator for multi-process runs writing
+    into one shared directory: ``""`` on rank 0 (and outside any
+    multi-process runtime — legacy names stay stable), ``".r<k>"`` on
+    rank k>0. Inserted before the extension of ``run_report.json`` and
+    ``flight_<tag>.json`` so a 2-process run stops silently clobbering
+    its own post-mortems."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            idx = int(jax.process_index())
+            if idx:
+                return f".r{idx}"
+    except Exception:
+        pass
+    return ""
+
+
 def new_trace_id() -> str:
     """Mint a trace id for the ``X-DL4J-Trace-Id`` header (16 hex chars
     — W3C-traceparent-sized, stdlib-only)."""
@@ -223,15 +242,40 @@ def export_snapshot(registry=None, health: Optional[dict] = None) -> dict:
 
 
 def push_snapshot(url: str, registry=None, health: Optional[dict] = None,
-                  timeout: float = 5.0) -> dict:
+                  timeout: float = 5.0, *, attempts: int = 1,
+                  backoff_initial_s: float = 0.2,
+                  backoff_factor: float = 2.0, backoff_max_s: float = 5.0,
+                  jitter: float = 0.5, sleep_fn=time.sleep) -> dict:
     """POST :func:`export_snapshot` to an aggregator's
-    ``/api/metrics_push`` endpoint; returns the aggregator's reply."""
+    ``/api/metrics_push`` endpoint; returns the aggregator's reply.
+
+    ``attempts > 1`` opts into retry with exponential backoff + jitter:
+    a restarting aggregator (connection refused, reset, 5xx) costs a
+    worker one delayed heartbeat instead of dropping it permanently.
+    The snapshot is re-exported per attempt so the delivered heartbeat
+    timestamp is fresh, not the first attempt's stale one. Jitter
+    de-synchronizes a fleet whose workers all lost the same aggregator
+    at the same moment (the thundering-herd reconnect)."""
+    import random
     import urllib.request
-    body = json.dumps(export_snapshot(registry, health)).encode()
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    attempts = max(1, int(attempts))
+    delay = backoff_initial_s
+    for attempt in range(attempts):
+        try:
+            body = json.dumps(export_snapshot(registry, health)).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except OSError:
+            # URLError (incl. HTTPError) subclasses OSError: covers
+            # refused/reset connections, DNS blips, and 5xx restarts
+            if attempt + 1 >= attempts:
+                raise
+            sleep_fn(min(backoff_max_s,
+                         delay * (1.0 + jitter * random.random())))
+            delay = min(delay * backoff_factor, backoff_max_s)
 
 
 # ---------------------------------------------------------------------------

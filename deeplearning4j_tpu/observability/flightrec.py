@@ -148,7 +148,12 @@ class FlightRecorder:
             doc["metrics"] = get_registry().snapshot()
         except Exception:
             pass
-        path = os.path.join(self.dir, f"flight_{_sanitize(ident.tag)}.json")
+        # rank-suffixed in multi-process runs (rank 0 keeps the legacy
+        # name): N workers sharing one checkpoint dir under a default
+        # identity would otherwise clobber each other's post-mortems
+        from deeplearning4j_tpu.observability.distributed import rank_suffix
+        path = os.path.join(
+            self.dir, f"flight_{_sanitize(ident.tag)}{rank_suffix()}.json")
         try:
             os.makedirs(self.dir, exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"

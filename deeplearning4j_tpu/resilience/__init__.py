@@ -5,12 +5,23 @@ checkpointing + atomic latest-pointer + retention GC, auto-resume from
 the newest valid checkpoint, transient-step retry with exponential
 backoff, a NaN/Inf rollback sentinel with learning-rate backoff, and
 clean SIGTERM preemption. ``faultinject`` provides the deterministic
-fault harness that keeps every one of those paths under test."""
+fault harness that keeps every one of those paths under test.
+``launcher`` sits one layer up: it spawns and watches a coordinated
+multi-process fleet and relaunches it (shrunk) when workers die, with
+survivors detecting lost peers via consensus timeouts and exiting
+``PEER_LOST_EXIT`` instead of checkpointing a forked history."""
 
 from deeplearning4j_tpu.resilience.faultinject import (
     FaultInjector,
     InjectedCrash,
     TransientStepError,
+)
+from deeplearning4j_tpu.resilience.launcher import (
+    PEER_LOST_EXIT,
+    FleetLauncher,
+    FleetResult,
+    LaunchRecord,
+    WorkerRecord,
 )
 from deeplearning4j_tpu.resilience.supervisor import (
     RecoveryEvent,
@@ -24,7 +35,11 @@ from deeplearning4j_tpu.resilience.supervisor import (
 
 __all__ = [
     "FaultInjector",
+    "FleetLauncher",
+    "FleetResult",
     "InjectedCrash",
+    "LaunchRecord",
+    "PEER_LOST_EXIT",
     "RecoveryEvent",
     "ResilienceStats",
     "SupervisorConfig",
@@ -32,5 +47,6 @@ __all__ = [
     "TrainingDivergedError",
     "TrainingSupervisor",
     "TransientStepError",
+    "WorkerRecord",
     "resilient_fit",
 ]

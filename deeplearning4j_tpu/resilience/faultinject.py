@@ -17,6 +17,16 @@ tests through this harness rather than hoped for:
   a step boundary (exercises the SIGTERM path without relying on signal
   delivery timing); :meth:`sigterm_at_step` delivers a real SIGTERM to
   the process instead.
+- :meth:`FaultInjector.kill_at_step` / :meth:`hang_at_step` — REAL
+  process death (SIGKILL: no handlers, no cleanup) and a stall longer
+  than the collective timeout; with ``rank=`` these target one fleet
+  member, which is how scripts/chaos_multihost.py murders a single
+  worker mid-epoch and asserts the survivors detect the loss.
+
+Every planner accepts ``rank=`` (default None = every process): the
+fault fires only on the process whose ``jax.process_index()`` matches,
+so one shared fault plan — constructed identically on every worker —
+expresses "kill rank 1 at step 5" without per-process branching.
 
 Faults are keyed by absolute step / save index, so a plan replays
 identically across process restarts — scripts/chaos_train.py relies on
@@ -26,9 +36,22 @@ parameters.
 
 from __future__ import annotations
 
+import signal as _signal
 from contextlib import contextmanager
 
 import numpy as np
+
+
+def _on_this_rank(rank) -> bool:
+    """True when a fault planned for ``rank`` should fire here (None =
+    everywhere). Outside a jax runtime, rank 0 is assumed."""
+    if rank is None:
+        return True
+    try:
+        import jax
+        return jax.process_index() == int(rank)
+    except Exception:
+        return int(rank) == 0
 
 
 class InjectedCrash(BaseException):
@@ -49,39 +72,63 @@ class FaultInjector:
     arms the checkpoint post-commit hook)."""
 
     def __init__(self):
-        self._step_failures = {}      # step -> remaining raise count
-        self._poison_steps = {}       # step -> remaining poison count
-        self._preempt_steps = set()   # clean preemption request
-        self._sigterm_steps = set()   # real SIGTERM delivery
+        self._step_failures = {}      # step -> [remaining raises, rank]
+        self._poison_steps = {}       # step -> [remaining poisons, rank]
+        self._preempt_steps = {}      # step -> rank (clean preemption)
+        self._sigterm_steps = {}      # step -> rank (real SIGTERM)
+        self._kill_steps = {}         # step -> (rank, signal)
+        self._hang_steps = {}         # step -> (seconds, rank)
         self._crash_saves = set()     # save index -> crash post-commit
         self._save_index = 0
         self.log: list[tuple] = []    # (fault, step/index) actually fired
 
     # ------------------------------------------------------------- planning
-    def fail_step(self, step: int, times: int = 1,):
+    def fail_step(self, step: int, times: int = 1, rank=None):
         """Raise TransientStepError on the first ``times`` attempts of
-        ``step`` (attempt times+1 then succeeds — retry fodder)."""
-        self._step_failures[int(step)] = int(times)
+        ``step`` (attempt times+1 then succeeds — retry fodder). With
+        ``rank=k`` only process k raises (its peers must still back off
+        with it — the coordinated-retry path)."""
+        self._step_failures[int(step)] = [int(times), rank]
         return self
 
-    def poison_step(self, step: int, times: int = 1):
+    def poison_step(self, step: int, times: int = 1, rank=None):
         """Before ``step`` (its first ``times`` attempts), set one
         parameter leaf to NaN — the fused step then yields a non-finite
-        loss, like a gradient blow-up or corrupted device buffer."""
-        self._poison_steps[int(step)] = int(times)
+        loss, like a gradient blow-up or corrupted device buffer. With
+        ``rank=k`` only process k is poisoned (its peers must still roll
+        back with it in lockstep)."""
+        self._poison_steps[int(step)] = [int(times), rank]
         return self
 
-    def preempt_at_step(self, step: int):
+    def preempt_at_step(self, step: int, rank=None):
         """Request a clean preemption once ``step`` is reached (the
-        supervisor finishes the in-flight step, checkpoints, exits)."""
-        self._preempt_steps.add(int(step))
+        supervisor finishes the in-flight step, checkpoints, exits).
+        With ``rank=k`` the request lands on one process; consensus
+        broadcasts it fleet-wide."""
+        self._preempt_steps[int(step)] = rank
         return self
 
-    def sigterm_at_step(self, step: int):
+    def sigterm_at_step(self, step: int, rank=None):
         """Deliver a real SIGTERM to this process at ``step`` — the
         supervisor's installed handler must turn it into a clean
         checkpoint-and-exit."""
-        self._sigterm_steps.add(int(step))
+        self._sigterm_steps[int(step)] = rank
+        return self
+
+    def kill_at_step(self, step: int, rank=None, sig=_signal.SIGKILL):
+        """REAL process death at ``step``: SIGKILL (default) gives no
+        handler a chance — exactly the footprint of an OOM-killed or
+        hard-preempted fleet member. Fires at the step boundary (before
+        the step's collective), so surviving peers detect the loss as a
+        consensus timeout, not a wedged psum."""
+        self._kill_steps[int(step)] = (rank, sig)
+        return self
+
+    def hang_at_step(self, step: int, seconds: float, rank=None):
+        """Stall this process ``seconds`` at ``step`` — longer than the
+        collective timeout, a hang is indistinguishable from death to
+        the peers (and the hung process finds them gone when it wakes)."""
+        self._hang_steps[int(step)] = (float(seconds), rank)
         return self
 
     def crash_during_save(self, save_index: int):
@@ -117,26 +164,44 @@ class FaultInjector:
     # -------------------------------------------------------- step-time hook
     def before_step(self, supervisor, net, step: int):
         """Called by the supervisor inside the retried region, once per
-        attempt of ``step``."""
+        attempt of ``step``. Rank-targeted faults fire only on their
+        process; the plan itself is identical everywhere."""
+        if step in self._hang_steps:
+            seconds, rank = self._hang_steps.pop(step)
+            if _on_this_rank(rank):
+                self.log.append(("hang", step))
+                import time
+                time.sleep(seconds)
+        if step in self._kill_steps:
+            rank, sig = self._kill_steps.pop(step)
+            if _on_this_rank(rank):
+                self.log.append(("kill", step))
+                import os
+                os.kill(os.getpid(), sig)
         if step in self._sigterm_steps:
-            self._sigterm_steps.discard(step)
-            self.log.append(("sigterm", step))
-            import os
-            import signal
-            os.kill(os.getpid(), signal.SIGTERM)
+            rank = self._sigterm_steps.pop(step)
+            if _on_this_rank(rank):
+                self.log.append(("sigterm", step))
+                import os
+                os.kill(os.getpid(), _signal.SIGTERM)
         if step in self._preempt_steps:
-            self._preempt_steps.discard(step)
-            self.log.append(("preempt", step))
-            supervisor.request_preemption()
-        if self._poison_steps.get(step, 0) > 0:
-            self._poison_steps[step] -= 1
-            self.log.append(("poison", step))
-            _poison_params(net)
-        if self._step_failures.get(step, 0) > 0:
-            self._step_failures[step] -= 1
-            self.log.append(("transient", step))
-            raise TransientStepError(f"injected transient failure at "
-                                     f"step {step}")
+            rank = self._preempt_steps.pop(step)
+            if _on_this_rank(rank):
+                self.log.append(("preempt", step))
+                supervisor.request_preemption()
+        poison = self._poison_steps.get(step)
+        if poison is not None and poison[0] > 0:
+            poison[0] -= 1
+            if _on_this_rank(poison[1]):
+                self.log.append(("poison", step))
+                _poison_params(net)
+        fail = self._step_failures.get(step)
+        if fail is not None and fail[0] > 0:
+            fail[0] -= 1
+            if _on_this_rank(fail[1]):
+                self.log.append(("transient", step))
+                raise TransientStepError(f"injected transient failure at "
+                                         f"step {step}")
 
 
 def _poison_params(net):

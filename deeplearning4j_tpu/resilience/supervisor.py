@@ -21,6 +21,18 @@ rendered onto this framework's fused-step training):
   (``net.set_lr_scale``); poisoned parameters are never checkpointed.
 - **Preemption (SIGTERM)**: the in-flight step finishes, a final
   checkpoint is written, and ``run`` returns with status ``preempted``.
+- **Cross-process coordination**: under ``jax.process_count() > 1``
+  every recovery decision above is routed through the consensus layer
+  in parallel/distributed.py (``agree_decision`` over tiny recovery
+  codes with a ``DL4J_TPU_COLLECTIVE_TIMEOUT_S`` deadline): any-NaN →
+  every process rolls back in lockstep, any-transient → every process
+  retries on the same backoff schedule, SIGTERM anywhere → fleet-wide
+  preemption with one final barriered checkpoint. A consensus round
+  that times out names a dead peer: the supervisor flushes a
+  ``peer_lost`` flight record, writes NO partial checkpoint, and
+  returns status ``peer_lost`` so a launcher (resilience/launcher.py)
+  can relaunch — possibly SHRUNK, whereupon the elastic reshard
+  restore re-lays the run onto the smaller fleet.
 
 Every recovery action is emitted as a :class:`RecoveryEvent` through the
 net's listeners (``TrainingListener.on_recovery``), counted in
@@ -63,7 +75,8 @@ class TrainingDivergedError(RuntimeError):
 @dataclass(frozen=True)
 class RecoveryEvent:
     """One supervisor action: kind is ``resume`` | ``checkpoint`` |
-    ``retry`` | ``rollback`` | ``preempt`` | ``gc`` | ``reshard``."""
+    ``retry`` | ``rollback`` | ``preempt`` | ``gc`` | ``reshard`` |
+    ``peer_lost``."""
     kind: str
     step: int
     detail: str = ""
@@ -88,6 +101,7 @@ class ResilienceStats:
         self.gc_removed = 0
         self.nan_check_lag = 0
         self.reshards = 0
+        self.peer_losses = 0
 
     def bump(self, counter: str, n: int = 1):
         with self._lock:
@@ -111,6 +125,7 @@ class ResilienceStats:
                 "checkpoints_gc_total": self.gc_removed,
                 "nan_check_lag_max": self.nan_check_lag,
                 "reshards_total": self.reshards,
+                "peer_losses_total": self.peer_losses,
             }
 
     # ------------------------------------------- unified-registry bridge
@@ -127,6 +142,8 @@ class ResilienceStats:
         "nan_check_lag_max": "Max steps the lazy NaN sentinel lagged",
         "reshards_total": "Resumes that re-laid the run onto a "
                           "different fleet size",
+        "peer_losses_total": "Consensus timeouts naming a dead peer "
+                             "(the run exited with status peer_lost)",
     }
 
     def metric_families(self, labels=None):
@@ -209,21 +226,35 @@ class SupervisorConfig:
     #: DL4J_TPU_COMPILE_CACHE env var, if set) — a restarted replacement
     #: process pointed at the same dir recompiles ~nothing
     compile_cache_dir: Optional[str] = None
+    #: route recovery decisions through the cross-process consensus
+    #: layer: "auto" (default) turns it on exactly when
+    #: jax.process_count() > 1 and a coordination-service client exists;
+    #: True/False force it (False runs a multi-process fleet with
+    #: process-LOCAL recovery — only safe when no fault ever fires)
+    coordinate: object = "auto"
+    #: per-run override for the consensus/barrier deadline (None = the
+    #: DL4J_TPU_COLLECTIVE_TIMEOUT_S env var / its default). A consensus
+    #: round exceeding it names a lost peer and ends the run
+    collective_timeout_s: Optional[float] = None
     #: injectable for tests (real runs sleep through backoff)
     sleep_fn: Callable[[float], None] = time.sleep
 
 
 @dataclass
 class SupervisorResult:
-    status: str                    # "completed" | "preempted"
+    status: str                    # "completed" | "preempted" | "peer_lost"
     final_step: int
     resumed_from: Optional[str]
     events: List[RecoveryEvent]
     stats: dict
     #: goodput.RunReport for the whole supervised run (None when the
-    #: goodput engine is disabled); also saved as run_report.json in
-    #: the checkpoint dir
+    #: goodput engine is disabled); also saved as run_report.json
+    #: (rank-suffixed ``run_report.r<k>.json`` off rank 0) in the
+    #: checkpoint dir
     report: Optional[object] = None
+    #: status == "peer_lost" detail: {"lost_ranks": [...],
+    #: "detection_s": float, "round": str} from the timed-out consensus
+    peer_loss: Optional[dict] = None
 
 
 class TrainingSupervisor:
@@ -244,6 +275,11 @@ class TrainingSupervisor:
         self.events: List[RecoveryEvent] = []
         self._preempt_requested = False
         self._last_good: Optional[str] = None
+        #: cross-process consensus routing (set per run by
+        #: _setup_coordination; False for single-process runs)
+        self._coordinated = False
+        #: filled when a consensus round named a dead peer
+        self.peer_loss: Optional[dict] = None
         #: datapipe.Pipeline being supervised (fit_pipeline): its
         #: state_dict rides in every checkpoint's meta.json and is
         #: restored alongside the net on resume/rollback
@@ -279,6 +315,49 @@ class TrainingSupervisor:
         except Exception:
             return None
 
+    # --------------------------------------------------- cross-process glue
+    def _setup_coordination(self):
+        """Decide (per run) whether recovery decisions go through the
+        consensus layer. Coordinated runs force synchronous checkpoints:
+        the save barriers are cross-process collectives and must run on
+        the thread making the consensus calls, in the same order on
+        every rank."""
+        from deeplearning4j_tpu.parallel import distributed as _dist
+        cfg = self.config
+        if isinstance(cfg.coordinate, bool):
+            self._coordinated = cfg.coordinate
+        else:
+            self._coordinated = _dist.consensus_available()
+        if self._coordinated and cfg.async_checkpoints:
+            logger.info(
+                "multi-process run: checkpoints forced synchronous — the "
+                "save barrier is a cross-process collective and must stay "
+                "on the consensus thread")
+        return self._coordinated
+
+    def _agree(self, code: int, name: str) -> List[int]:
+        from deeplearning4j_tpu.parallel import distributed as _dist
+        return _dist.agree_decision(
+            code, name=name, timeout_s=self.config.collective_timeout_s)
+
+    def _any_process(self, flag: bool, name: str) -> bool:
+        return any(self._agree(1 if flag else 0, name))
+
+    def _on_peer_lost(self, exc) -> None:
+        """A consensus round named a dead peer: record it, flush the
+        black box, and write NOTHING further — the last barriered
+        checkpoint (meta.json committed on every rank) is the newest
+        restorable state, and any save attempt now would just hang on
+        the corpse."""
+        self.peer_loss = {
+            "lost_ranks": list(getattr(exc, "lost_ranks", [])),
+            "detection_s": getattr(exc, "elapsed_s", None),
+            "round": getattr(exc, "round_name", ""),
+        }
+        self._emit("peer_lost", self.net.iteration,
+                   f"{exc}", counter="peer_losses")
+        self._flight_flush("peer_lost", exc=exc)
+
     # --------------------------------------------------------------- events
     def _emit(self, kind: str, step: int, detail: str = "",
               counter: Optional[str] = None):
@@ -305,7 +384,12 @@ class TrainingSupervisor:
     def _write_latest_pointer(self, path: str):
         # atomic latest-pointer: observers (and a quick resume fast path)
         # read one small file; the rename is the commit point, so the
-        # pointer never names a half-written checkpoint
+        # pointer never names a half-written checkpoint. Multi-process:
+        # rank 0 only — N processes share the checkpoint dir, and
+        # concurrent writers to one .tmp path would interleave
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
         tmp = os.path.join(self.config.checkpoint_dir,
                            "." + _LATEST_POINTER + ".tmp")
         with open(tmp, "w") as f:
@@ -335,7 +419,7 @@ class TrainingSupervisor:
         extra = None
         if self._pipeline is not None:
             extra = {"datapipe": self._pipeline.state_dict()}
-        if not cfg.async_checkpoints:
+        if not cfg.async_checkpoints or self._coordinated:
             with tracer.span("checkpoint_write", step=step, reason=reason):
                 save_checkpoint(self.net, path, stats=self.stats_collector,
                                 extra_meta=extra)
@@ -403,7 +487,12 @@ class TrainingSupervisor:
         """Retention: keep the newest ``keep_checkpoints`` valid steps;
         also sweep partial saves older than the latest valid one (they
         can never be resumed from and would otherwise accumulate one per
-        crash)."""
+        crash). Multi-process: rank 0 only — checkpoints sit in a shared
+        directory, and the post-save barrier guarantees no peer is still
+        reading a directory rank 0 sweeps."""
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
         from deeplearning4j_tpu.utils.checkpoint import (_STEP_DIR,
                                                          is_valid_checkpoint)
         root = self.config.checkpoint_dir
@@ -524,6 +613,14 @@ class TrainingSupervisor:
             if self._ledger is not None:
                 self._ledger.annotate(reshard=reshard_detail)
 
+        if self._coordinated:
+            # restore barrier: no process races ahead of the orbax
+            # commit (into training — or worse, into a rank-0 GC sweep)
+            # while a peer is still reading this checkpoint
+            from deeplearning4j_tpu.parallel import distributed as _dist
+            _dist.barrier("dl4j_restore_done",
+                          timeout_s=self.config.collective_timeout_s)
+
     # ------------------------------------------------------------- stepping
     def request_preemption(self):
         """Ask for a clean stop at the next step boundary (what the
@@ -542,26 +639,56 @@ class TrainingSupervisor:
     def _attempt_step(self, ds, step: int):
         """One fit_batch with transient-failure retry + exponential
         backoff. The injector's before_step hook runs inside the retried
-        region so injected transient faults exercise this exact path."""
+        region so injected transient faults exercise this exact path.
+
+        Coordinated runs add a pre-step consensus round per attempt:
+        nobody enters the compiled step (whose gradient psum is a
+        collective) unless EVERY process is ready, and a transient on
+        any rank backs the whole fleet off on the same schedule — the
+        single-process retry semantics, made deadlock-free. A failure
+        that surfaces INSIDE the collective step cannot be retried in
+        lockstep (peers are already mid-psum) and propagates."""
         cfg = self.config
         delay = cfg.backoff_initial_s
         attempt = 0
         while True:
+            err = None
             try:
                 if self.injector is not None:
                     self.injector.before_step(self, self.net, step)
-                return self.net.fit_batch(ds)
             except cfg.retry_on as e:
-                attempt += 1
-                if attempt > cfg.max_step_retries:
-                    raise
-                self._emit(
-                    "retry", step,
-                    f"attempt {attempt}/{cfg.max_step_retries} after "
-                    f"{type(e).__name__}: {e}; backoff {delay:.3f}s",
-                    counter="retries")
-                cfg.sleep_fn(delay)
-                delay = min(delay * cfg.backoff_factor, cfg.backoff_max_s)
+                err = e
+            if self._coordinated:
+                failed = any(self._agree(0 if err is None else 1, "step"))
+            else:
+                failed = err is not None
+            if not failed:
+                try:
+                    return self.net.fit_batch(ds)
+                except cfg.retry_on as e:
+                    if self._coordinated:
+                        raise
+                    err = e
+            attempt += 1
+            if attempt > cfg.max_step_retries:
+                if err is not None:
+                    raise err
+                from deeplearning4j_tpu.resilience.faultinject import \
+                    TransientStepError
+                raise TransientStepError(
+                    f"a peer process kept failing step {step} past "
+                    f"{cfg.max_step_retries} coordinated retries")
+            if err is not None:
+                cause = f"{type(err).__name__}: {err}"
+            else:
+                cause = "peer transient failure"
+            self._emit(
+                "retry", step,
+                f"attempt {attempt}/{cfg.max_step_retries} after "
+                f"{cause}; backoff {delay:.3f}s",
+                counter="retries")
+            cfg.sleep_fn(delay)
+            delay = min(delay * cfg.backoff_factor, cfg.backoff_max_s)
 
     def _flush_nan_checks(self):
         """Materialize every pending lazy score (device sync happens HERE,
@@ -577,6 +704,28 @@ class TrainingSupervisor:
             if bad is None and not math.isfinite(float(score)):
                 bad = (step, float(score))
         return bad
+
+    def _agreed_bad(self):
+        """The fleet-wide NaN decision. Single-process: just the local
+        flush. Coordinated: every process publishes its local verdict
+        (0 = clean, step+1 = first bad step) and the agreed outcome is
+        the MINIMUM bad step any rank saw — so one poisoned rank rolls
+        every rank back to the same checkpoint, in lockstep, even the
+        ranks whose local losses were finite. Call sites are
+        schedule-aligned (same steps, same due boundaries), so the
+        consensus rounds line up by construction."""
+        bad = self._flush_nan_checks()
+        if not self._coordinated:
+            return bad
+        code = (bad[0] + 1) if bad is not None else 0
+        codes = self._agree(code, "nan")
+        hits = [c - 1 for c in codes if c]
+        if not hits:
+            return None
+        step = min(hits)
+        score = bad[1] if bad is not None and bad[0] == step else \
+            float("nan")
+        return (step, score)
 
     def _rollback(self, step: int, score: float, rollbacks: int):
         cfg = self.config
@@ -612,6 +761,7 @@ class TrainingSupervisor:
         ``batch_fn(step)`` at each step. Resumable: relaunching with the
         same arguments continues from the newest valid checkpoint to the
         same final step."""
+        from deeplearning4j_tpu.parallel import distributed as _dist
         from deeplearning4j_tpu.utils.checkpoint import (
             find_latest_checkpoint)
         cfg = self.config
@@ -621,6 +771,7 @@ class TrainingSupervisor:
         _obs_metrics.install_runtime_metrics()
         from deeplearning4j_tpu.compilecache import configure as _cc_configure
         _cc_configure(cfg.compile_cache_dir)  # falls back to env var
+        self._setup_coordination()
         # attach (and stay attached after run(): a post-run scrape still
         # reports this job's recovery counters alongside serving/compile
         # series from the same process)
@@ -645,64 +796,85 @@ class TrainingSupervisor:
                       is threading.main_thread())
         if use_signal:
             old_handler = signal.signal(signal.SIGTERM, self._sigterm)
+        rollbacks = 0
+        status = "completed"
         try:
-            if self._last_good is None and net.iteration < target_step:
-                # baseline save: the NaN sentinel needs a rollback target
-                # from the very first step, and a crash before the first
-                # periodic save must not lose the (possibly expensive)
-                # initialization
-                self._checkpoint(net.iteration, "baseline")
+            try:
+                if (self._last_good is None
+                        and net.iteration < target_step):
+                    # baseline save: the NaN sentinel needs a rollback
+                    # target from the very first step, and a crash before
+                    # the first periodic save must not lose the (possibly
+                    # expensive) initialization
+                    self._checkpoint(net.iteration, "baseline")
 
-            rollbacks = 0
-            status = "completed"
-            while True:
-                if self._preempt_requested:
-                    status = "preempted"
-                    break
-                if net.iteration >= target_step:
-                    # tail flush: the last chunk of lazy scores may hold
-                    # poison — a rollback rewinds iteration and re-enters
-                    bad = self._flush_nan_checks()
+                while True:
+                    if self._coordinated:
+                        # one consensus round per loop pass: SIGTERM (or
+                        # an injected preempt) on ANY rank stops every
+                        # rank at this same step boundary
+                        if self._any_process(self._preempt_requested,
+                                             "preempt"):
+                            self._preempt_requested = True
+                    if self._preempt_requested:
+                        status = "preempted"
+                        break
+                    if net.iteration >= target_step:
+                        # tail flush: the last chunk of lazy scores may
+                        # hold poison — a rollback rewinds iteration and
+                        # re-enters
+                        bad = self._agreed_bad()
+                        if bad is not None:
+                            rollbacks += 1
+                            self._rollback(bad[0], bad[1], rollbacks)
+                            continue
+                        break
+                    step = net.iteration
+                    score = self._attempt_step(batch_fn(step), step)
+                    if cfg.nan_check_every > 0:
+                        self._pending_scores.append((step, score))
+                    due_check = (cfg.nan_check_every > 0
+                                 and net.iteration % cfg.nan_check_every
+                                 == 0)
+                    due_ckpt = (net.iteration % cfg.checkpoint_every_steps
+                                == 0 and net.iteration < target_step)
+                    if (due_check or due_ckpt) and self._pending_scores:
+                        # every score up to here is verified finite
+                        # BEFORE a snapshot is taken: poison is never
+                        # checkpointed, even with a lagging
+                        # (nan_check_every > 1) sentinel
+                        bad = self._agreed_bad()
+                        if bad is not None:
+                            rollbacks += 1
+                            self._rollback(bad[0], bad[1], rollbacks)
+                            continue
+                    if due_ckpt:
+                        self._checkpoint(net.iteration, "periodic")
+
+                if status == "preempted":
+                    bad = self._agreed_bad()
                     if bad is not None:
+                        # never checkpoint poison, even on the way out
                         rollbacks += 1
                         self._rollback(bad[0], bad[1], rollbacks)
-                        continue
-                    break
-                step = net.iteration
-                score = self._attempt_step(batch_fn(step), step)
-                if cfg.nan_check_every > 0:
-                    self._pending_scores.append((step, score))
-                due_check = (cfg.nan_check_every > 0
-                             and net.iteration % cfg.nan_check_every == 0)
-                due_ckpt = (net.iteration % cfg.checkpoint_every_steps == 0
-                            and net.iteration < target_step)
-                if (due_check or due_ckpt) and self._pending_scores:
-                    # every score up to here is verified finite BEFORE a
-                    # snapshot is taken: poison is never checkpointed,
-                    # even with a lagging (nan_check_every > 1) sentinel
-                    bad = self._flush_nan_checks()
-                    if bad is not None:
-                        rollbacks += 1
-                        self._rollback(bad[0], bad[1], rollbacks)
-                        continue
-                if due_ckpt:
-                    self._checkpoint(net.iteration, "periodic")
-
-            if status == "preempted":
-                bad = self._flush_nan_checks()
-                if bad is not None:
-                    # never checkpoint poison, even on the way out
-                    rollbacks += 1
-                    self._rollback(bad[0], bad[1], rollbacks)
-                self._checkpoint(net.iteration, "preemption", wait=True)
-                self._emit("preempt", net.iteration,
-                           f"clean exit at step {net.iteration} of "
-                           f"{target_step}", counter="preemptions")
-                self._flight_flush("preemption")
-            else:
-                self._drain_checkpoint()  # settle _last_good first
-                if self._last_good != self._step_dir(net.iteration):
-                    self._checkpoint(net.iteration, "final", wait=True)
+                    self._checkpoint(net.iteration, "preemption",
+                                     wait=True)
+                    self._emit("preempt", net.iteration,
+                               f"clean exit at step {net.iteration} of "
+                               f"{target_step}", counter="preemptions")
+                    self._flight_flush("preemption")
+                else:
+                    self._drain_checkpoint()  # settle _last_good first
+                    if self._last_good != self._step_dir(net.iteration):
+                        self._checkpoint(net.iteration, "final", wait=True)
+            except _dist.PeerLostError as e:
+                # a peer died mid-run: flush the post-mortem, write NO
+                # partial checkpoint (any save barrier would hang on the
+                # corpse; the meta.json invariant keeps half-saves
+                # non-restorable), exit with a distinct status for the
+                # launcher
+                status = "peer_lost"
+                self._on_peer_lost(e)
         finally:
             if use_signal:
                 signal.signal(signal.SIGTERM, old_handler)
@@ -719,12 +891,20 @@ class TrainingSupervisor:
                 _goodput.end_run(ledger, status="failed")
 
         report = _goodput.end_run(
-            ledger, status=status,
-            save_to=os.path.join(cfg.checkpoint_dir, "run_report.json"))
+            ledger, status=status, save_to=self._report_path())
         return SupervisorResult(
             status=status, final_step=net.iteration,
             resumed_from=resumed_from, events=list(self.events),
-            stats=self.stats.snapshot(), report=report)
+            stats=self.stats.snapshot(), report=report,
+            peer_loss=self.peer_loss)
+
+    def _report_path(self) -> str:
+        """``run_report.json`` — rank-suffixed (``run_report.r<k>.json``)
+        off rank 0, so N processes sharing one checkpoint dir stop
+        clobbering each other's reports."""
+        from deeplearning4j_tpu.observability.distributed import rank_suffix
+        return os.path.join(self.config.checkpoint_dir,
+                            f"run_report{rank_suffix()}.json")
 
     # ------------------------------------------------------- pipeline loop
     def fit_pipeline(self, pipeline, *, epochs: int = 1) -> SupervisorResult:
@@ -744,11 +924,13 @@ class TrainingSupervisor:
         self._pipeline = pipeline
         resumed_from = None
 
+        from deeplearning4j_tpu.parallel import distributed as _dist
         from deeplearning4j_tpu.utils.checkpoint import (
             find_latest_checkpoint)
         _obs_metrics.install_runtime_metrics()
         from deeplearning4j_tpu.compilecache import configure as _cc_configure
         _cc_configure(cfg.compile_cache_dir)  # falls back to env var
+        self._setup_coordination()
         self.stats.attach_to_registry(
             labels={"job": os.path.basename(
                 os.path.normpath(cfg.checkpoint_dir))})
@@ -782,68 +964,94 @@ class TrainingSupervisor:
                 stream.close()
                 stream = None
 
+        rollbacks = 0
+        status = "completed"
         try:
-            if self._last_good is None:
-                # baseline save: rollback target from the very first
-                # step, now including the pipeline's start-of-run state
-                self._checkpoint(net.iteration, "baseline")
+            try:
+                if self._last_good is None:
+                    # baseline save: rollback target from the very first
+                    # step, now including the pipeline's start-of-run
+                    # state
+                    self._checkpoint(net.iteration, "baseline")
 
-            rollbacks = 0
-            status = "completed"
-            while True:
-                if self._preempt_requested:
-                    status = "preempted"
-                    break
-                if stream is None:
-                    stream = pipeline.stream(epochs)
-                ds = next(stream, None)
-                if ds is None:
-                    # stream exhausted — but the lazy-score tail may hold
-                    # poison; a rollback rewinds data position too and
-                    # re-enters the loop with a rebuilt stream
-                    bad = self._flush_nan_checks()
+                while True:
+                    if self._coordinated:
+                        # preemption consensus BEFORE pulling a batch:
+                        # the final checkpoint's data cursor must not
+                        # have consumed a record nobody trained on
+                        if self._any_process(self._preempt_requested,
+                                             "preempt"):
+                            self._preempt_requested = True
+                    if self._preempt_requested:
+                        status = "preempted"
+                        break
+                    if stream is None:
+                        stream = pipeline.stream(epochs)
+                    ds = next(stream, None)
+                    if self._coordinated:
+                        # epoch-end is a fleet decision: the first shard
+                        # to run dry ends the epoch everywhere (peers
+                        # drop their surplus — mirroring LocalSGD's
+                        # windowed agreement), because a lone finisher
+                        # heading for the exit barrier while others keep
+                        # training is a deadlock
+                        exhausted = self._any_process(ds is None, "data")
+                    else:
+                        exhausted = ds is None
+                    if exhausted:
+                        # stream exhausted — but the lazy-score tail may
+                        # hold poison; a rollback rewinds data position
+                        # too and re-enters the loop with a rebuilt
+                        # stream
+                        bad = self._agreed_bad()
+                        if bad is not None:
+                            rollbacks += 1
+                            invalidate_stream()
+                            self._rollback(bad[0], bad[1], rollbacks)
+                            continue
+                        break
+                    step = net.iteration
+                    score = self._attempt_step(ds, step)
+                    if cfg.nan_check_every > 0:
+                        self._pending_scores.append((step, score))
+                    due_check = (cfg.nan_check_every > 0
+                                 and net.iteration % cfg.nan_check_every
+                                 == 0)
+                    due_ckpt = (net.iteration % cfg.checkpoint_every_steps
+                                == 0)
+                    if (due_check or due_ckpt) and self._pending_scores:
+                        bad = self._agreed_bad()
+                        if bad is not None:
+                            rollbacks += 1
+                            invalidate_stream()
+                            self._rollback(bad[0], bad[1], rollbacks)
+                            continue
+                    if due_ckpt:
+                        self._checkpoint(net.iteration, "periodic")
+
+                if status == "preempted":
+                    bad = self._agreed_bad()
                     if bad is not None:
                         rollbacks += 1
                         invalidate_stream()
                         self._rollback(bad[0], bad[1], rollbacks)
-                        continue
-                    break
-                step = net.iteration
-                score = self._attempt_step(ds, step)
-                if cfg.nan_check_every > 0:
-                    self._pending_scores.append((step, score))
-                due_check = (cfg.nan_check_every > 0
-                             and net.iteration % cfg.nan_check_every == 0)
-                due_ckpt = net.iteration % cfg.checkpoint_every_steps == 0
-                if (due_check or due_ckpt) and self._pending_scores:
-                    bad = self._flush_nan_checks()
-                    if bad is not None:
-                        rollbacks += 1
-                        invalidate_stream()
-                        self._rollback(bad[0], bad[1], rollbacks)
-                        continue
-                if due_ckpt:
-                    self._checkpoint(net.iteration, "periodic")
-
-            if status == "preempted":
-                bad = self._flush_nan_checks()
-                if bad is not None:
-                    rollbacks += 1
+                    # park the prefetch workers so the saved pipeline
+                    # state is the final word on data position
                     invalidate_stream()
-                    self._rollback(bad[0], bad[1], rollbacks)
-                # park the prefetch workers so the saved pipeline state
-                # is the final word on data position
-                invalidate_stream()
-                self._checkpoint(net.iteration, "preemption", wait=True)
-                self._emit("preempt", net.iteration,
-                           f"clean exit at step {net.iteration} (datapipe "
-                           f"epoch {pipeline.epoch} of {epochs})",
-                           counter="preemptions")
-                self._flight_flush("preemption")
-            else:
-                self._drain_checkpoint()  # settle _last_good first
-                if self._last_good != self._step_dir(net.iteration):
-                    self._checkpoint(net.iteration, "final", wait=True)
+                    self._checkpoint(net.iteration, "preemption",
+                                     wait=True)
+                    self._emit("preempt", net.iteration,
+                               f"clean exit at step {net.iteration} "
+                               f"(datapipe epoch {pipeline.epoch} of "
+                               f"{epochs})", counter="preemptions")
+                    self._flight_flush("preemption")
+                else:
+                    self._drain_checkpoint()  # settle _last_good first
+                    if self._last_good != self._step_dir(net.iteration):
+                        self._checkpoint(net.iteration, "final", wait=True)
+            except _dist.PeerLostError as e:
+                status = "peer_lost"
+                self._on_peer_lost(e)
         finally:
             if use_signal:
                 signal.signal(signal.SIGTERM, old_handler)
@@ -858,12 +1066,12 @@ class TrainingSupervisor:
                 _goodput.end_run(ledger, status="failed")
 
         report = _goodput.end_run(
-            ledger, status=status,
-            save_to=os.path.join(cfg.checkpoint_dir, "run_report.json"))
+            ledger, status=status, save_to=self._report_path())
         return SupervisorResult(
             status=status, final_step=net.iteration,
             resumed_from=resumed_from, events=list(self.events),
-            stats=self.stats.snapshot(), report=report)
+            stats=self.stats.snapshot(), report=report,
+            peer_loss=self.peer_loss)
 
     # ----------------------------------------------------------- fit facade
     def fit(self, data, labels=None, *, epochs: int = 1,

@@ -215,6 +215,15 @@ def _layout_manifest(net, extra_meta) -> dict:
     }
 
 
+def _mp_barrier(tag: str):
+    """Deadline-capable cross-process barrier around the save sequence
+    (parallel.distributed.barrier: coordination-service native, raises
+    PeerLostError after DL4J_TPU_COLLECTIVE_TIMEOUT_S instead of
+    hanging on a dead peer; no-op single-process)."""
+    from deeplearning4j_tpu.parallel import distributed as _dist
+    _dist.barrier(tag)
+
+
 def _save_checkpoint_inner(net, path: str, extra_meta=None):
     path = os.path.abspath(path)
     ckptr = _checkpointer()
@@ -224,6 +233,14 @@ def _save_checkpoint_inner(net, path: str, extra_meta=None):
     ckptr.wait_until_finished()
     if _POST_COMMIT_HOOK is not None:
         _POST_COMMIT_HOOK(path)
+    multi = jax.process_count() > 1
+    if multi:
+        # pre-meta barrier: meta.json is the validity commit point, so
+        # it may land ONLY once every process's tree shards are durable.
+        # A peer dying mid-save times this barrier out (PeerLostError)
+        # BEFORE meta exists — the partial save is never restorable,
+        # which is the cross-host half of the crash-atomicity contract.
+        _mp_barrier("dl4j_ckpt_tree_committed")
     if jax.process_index() == 0:
         # layout.json lands BEFORE the meta.json rename, so meta's
         # presence still certifies the complete checkpoint (tree +
@@ -250,13 +267,11 @@ def _save_checkpoint_inner(net, path: str, extra_meta=None):
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, os.path.join(path, "meta.json"))
-    if jax.process_count() > 1:
-        # cross-process barrier AFTER the meta.json rename: without it a
-        # non-zero process returns as soon as its own shard writes land
-        # and can race a restore/guess_format against process 0 still
-        # finalizing — save_checkpoint must mean "complete everywhere"
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("dl4j_tpu_ckpt_save_done")
+    if multi:
+        # post-meta barrier: a non-zero process must not return (and the
+        # supervisor must not GC / resume-scan) until the rename landed
+        # everywhere — save_checkpoint means "complete everywhere"
+        _mp_barrier("dl4j_ckpt_save_done")
     return path
 
 

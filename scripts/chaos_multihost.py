@@ -1,0 +1,478 @@
+"""Cross-process chaos drill: murder real fleet members and prove the
+fleet recovers — coordinated, detected, relaunched, bit-identical.
+
+Three arms, each a REAL multi-process jax.distributed fleet (2 workers
+x 2 virtual CPU devices, one 4-device data mesh) spawned through
+``resilience.launcher.FleetLauncher``; this same script is the worker
+(``--worker``), so the fault plan is constructed identically on every
+rank and fires only where targeted:
+
+**Arm A — lockstep NaN rollback.** Rank 0 alone is poisoned (NaN param
+leaf at step 4). The NaN consensus round must roll BOTH ranks back to
+the same checkpoint — the poisoned rank and the healthy one — and the
+replayed fleet must finish with params bit-identical across ranks AND
+bit-identical to a no-fault control fleet of the same shape.
+
+**Arm B — peer death, detection, elastic relaunch.** Rank 1 takes a
+real SIGKILL at step 5 (no handlers, no cleanup). Rank 0 must detect
+the loss as a consensus timeout within the collective deadline, flush a
+``peer_lost`` flight record, write NO further checkpoint, and exit
+``PEER_LOST_EXIT``. The launcher then relaunches the fleet SHRUNK to
+one process (same 4 global devices), which elastically restores the
+2-process checkpoint: params land on the new layout, the datapipe
+shard cursor remaps at the coverage rule's low-water mark (a
+``reshard`` RecoveryEvent), and the survivor's final params are
+bit-identical to a hand-replayed control on the same topology. The
+records consumed after restore tile the epoch exactly from the
+low-water mark — nothing dropped, nothing doubled.
+
+**Arm C — SIGTERM broadcast.** A real SIGTERM lands on rank 1 only.
+The preemption consensus must broadcast it: both ranks stop at the SAME
+step boundary, write ONE final barriered checkpoint, and exit cleanly
+with per-rank run reports (``run_report.json`` + ``run_report.r1.json``)
+and per-rank flight artifacts.
+
+The meta.json validity invariant is audited between Arm B launches:
+after the kill, the newest restorable checkpoint is the last one that
+completed on every rank — no partial save is ever restorable.
+
+Run: ``python scripts/chaos_multihost.py --out CROSSHOST_r01.json``
+(CPU, ~3 min — dominated by per-worker XLA compiles). The receipt is
+gated by ``scripts/check_budgets.py --bench`` against the
+``cross_host`` section of BUDGETS.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    # the parent needs the same 4 devices as the resumed lone survivor,
+    # so the Arm B control replay runs on matching topology
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import numpy as np
+
+SEED = 17
+N_RECORDS = 64
+GLOBAL_BATCH = 8          # records per step, whole fleet
+TOTAL_DEVICES = 4         # constant across fleet sizes (2x2 -> 1x4)
+CKPT_EVERY = 3
+POISON_STEP = 4           # Arm A: NaN lands on rank 0 here
+KILL_STEP = 5             # Arm B: SIGKILL lands on rank 1 here
+SIGTERM_STEP = 4          # Arm C: SIGTERM lands on rank 1 here
+DETECT_TIMEOUT_S = 20.0   # Arm B consensus deadline (budget: <= 30s)
+
+
+def build_net(seed):
+    import jax  # noqa: F401  (x64 flag set by caller)
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.core import DtypePolicy
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    f64 = DtypePolicy(param_dtype="float64", compute_dtype="float64")
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .dtype(f64).list()
+            .layer(Dense(n_in=12, n_out=16, activation="tanh"))
+            .layer(Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_data(seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N_RECORDS, 12))
+    x[:, 0] = np.arange(N_RECORDS)  # record id rides in feature column 0
+    y = np.eye(4)[rng.integers(0, 4, N_RECORDS)]
+    return x, y
+
+
+def build_pipeline(x, y, num_shards, index, tracker, batch):
+    """shard -> map(track record ids) -> batch — the same 1:1 tracking
+    stage chaos_reshard.py uses, so the elastic remap accepts it."""
+    from deeplearning4j_tpu import datapipe
+
+    def track(rec):
+        tracker.append(int(round(float(rec[0][0]))))
+        return rec
+
+    return (datapipe.from_arrays(x, y).shard(num_shards, index)
+            .map(track).batch(batch))
+
+
+def flat_params(net):
+    import jax
+    return {f"{ln}.{pn}": np.asarray(jax.device_get(arr))
+            for ln, sub in net.params.items() for pn, arr in sub.items()}
+
+
+# ----------------------------------------------------------------- worker
+def run_worker(args) -> int:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from deeplearning4j_tpu.parallel import distributed
+    if args.size > 1:
+        distributed.initialize(args.coord, args.size, args.rank)
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.resilience import (PEER_LOST_EXIT,
+                                               FaultInjector,
+                                               SupervisorConfig,
+                                               TrainingSupervisor)
+
+    net = build_net(SEED).use_mesh(make_mesh({"data": len(jax.devices())}))
+    x, y = build_data(SEED)
+    seen: list = []
+    pipe = build_pipeline(x, y, args.size, args.rank, seen,
+                          GLOBAL_BATCH // args.size)
+
+    # one fault plan, built identically on EVERY rank; rank= targets it
+    injector = FaultInjector()
+    if args.poison_step >= 0:
+        injector.poison_step(args.poison_step, rank=args.poison_rank)
+    if args.kill_step >= 0:
+        injector.kill_at_step(args.kill_step, rank=args.kill_rank)
+    if args.sigterm_step >= 0:
+        injector.sigterm_at_step(args.sigterm_step, rank=args.sigterm_rank)
+
+    cfg = SupervisorConfig(
+        checkpoint_dir=args.ckpt_dir,
+        checkpoint_every_steps=CKPT_EVERY,
+        keep_checkpoints=10,        # the drill audits old steps post-hoc
+        backoff_initial_s=0.01,
+        # bit-identity standard: a rollback must replay the control
+        # trajectory exactly, so the LR stays untouched in this drill
+        nan_lr_backoff=1.0)
+    sup = TrainingSupervisor(net, cfg, injector=injector)
+    with injector.installed():
+        res = sup.fit_pipeline(pipe, epochs=1)
+
+    inc = os.environ.get("DL4J_TPU_INCARNATION", "0")
+    os.makedirs(args.out_dir, exist_ok=True)
+    np.savez(os.path.join(args.out_dir,
+                          f"params_l{inc}_r{args.rank}.npz"),
+             **flat_params(net))
+    result = {
+        "arm": args.arm, "rank": args.rank, "size": args.size,
+        "incarnation": int(inc), "status": res.status,
+        "final_step": res.final_step,
+        "resumed_from": (res.resumed_from
+                         and os.path.basename(res.resumed_from)),
+        "events": [{"kind": e.kind, "step": e.step, "detail": e.detail}
+                   for e in res.events],
+        "stats": res.stats,
+        "peer_loss": res.peer_loss,
+        "seen": seen,
+    }
+    path = os.path.join(args.out_dir, f"result_l{inc}_r{args.rank}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(result, fh, indent=1)
+    os.replace(tmp, path)
+    print(f"[worker r{args.rank} l{inc}] {res.status} at step "
+          f"{res.final_step}", flush=True)
+    if res.status == "peer_lost":
+        # hard exit: the interpreter's atexit jax.distributed shutdown
+        # would block on a barrier the dead peer can never join, pinning
+        # this process until the launcher's grace window SIGKILLs it
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(PEER_LOST_EXIT)
+    return 0
+
+
+# ----------------------------------------------------------------- parent
+def _load_result(out_dir, launch, rank):
+    with open(os.path.join(out_dir,
+                           f"result_l{launch}_r{rank}.json")) as fh:
+        return json.load(fh)
+
+
+def _load_params(out_dir, launch, rank):
+    return dict(np.load(os.path.join(
+        out_dir, f"params_l{launch}_r{rank}.npz")))
+
+
+def _assert_params_equal(a: dict, b: dict, what: str):
+    assert sorted(a) == sorted(b), (what, sorted(a), sorted(b))
+    for key in a:
+        np.testing.assert_array_equal(a[key], b[key],
+                                      err_msg=f"{what}: {key}")
+
+
+def _events(result, kind):
+    return [e for e in result["events"] if e["kind"] == kind]
+
+
+def run_fleet(arm, ckpt_dir, out_dir, fault_flags, *, size=2,
+              max_launches=1, audit=None, timeout_s=None):
+    from deeplearning4j_tpu.resilience.launcher import FleetLauncher
+
+    script = os.path.abspath(__file__)
+
+    def build_argv(n, rank, coord):
+        return [sys.executable, script, "--worker",
+                "--coord", coord, "--size", str(n), "--rank", str(rank),
+                "--ckpt-dir", ckpt_dir, "--out-dir", out_dir,
+                "--arm", arm, *fault_flags]
+
+    extra_env = {"JAX_PLATFORMS": "cpu"}
+    if timeout_s is not None:
+        extra_env["DL4J_TPU_COLLECTIVE_TIMEOUT_S"] = str(timeout_s)
+
+    class AuditedLauncher(FleetLauncher):
+        def launch_once(self, n, launch_index=0):
+            rec = super().launch_once(n, launch_index)
+            if audit is not None:
+                audit(rec)
+            return rec
+
+    launcher = AuditedLauncher(
+        build_argv, min_size=1, max_launches=max_launches,
+        total_devices=TOTAL_DEVICES, straggler_grace_s=90.0,
+        launch_timeout_s=420.0, extra_env=extra_env, log_dir=out_dir)
+    return launcher.run(size)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dir", default=None,
+                    help="work directory (default: fresh tempdir)")
+    ap.add_argument("--out", default=None,
+                    help="write the receipt JSON here (CROSSHOST_r01.json)")
+    # worker mode (internal): spawned by the FleetLauncher
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--coord", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--size", type=int, default=1, help=argparse.SUPPRESS)
+    ap.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-dir", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--out-dir", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--arm", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--poison-step", type=int, default=-1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--poison-rank", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--kill-step", type=int, default=-1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--kill-rank", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--sigterm-step", type=int, default=-1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--sigterm-rank", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.worker:
+        return run_worker(args)
+
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s: %(message)s")
+    root = args.dir or tempfile.mkdtemp(prefix="chaos_multihost_")
+    os.makedirs(root, exist_ok=True)
+    d = {name: os.path.join(root, name)
+         for name in ("ckptA", "outA", "ckptA0", "outA0",
+                      "ckptB", "outB", "ckptC", "outC")}
+
+    from deeplearning4j_tpu.resilience.launcher import PEER_LOST_EXIT
+    from deeplearning4j_tpu.utils.checkpoint import (find_latest_checkpoint,
+                                                     read_checkpoint_meta)
+
+    steps_per_epoch = N_RECORDS // GLOBAL_BATCH
+
+    # ============ Arm A: rank-0 poison -> fleet-wide lockstep rollback
+    print(f"\n[armA] 2-proc fleet, NaN poison on rank 0 at step "
+          f"{POISON_STEP} (dir {root})")
+    resA = run_fleet("A", d["ckptA"], d["outA"],
+                     ["--poison-step", str(POISON_STEP),
+                      "--poison-rank", "0"])
+    assert resA.status == "completed" and len(resA.launches) == 1, resA
+    print("[armA0] no-fault control fleet, same shape")
+    resA0 = run_fleet("A0", d["ckptA0"], d["outA0"], [])
+    assert resA0.status == "completed", resA0
+
+    rA = [_load_result(d["outA"], 0, r) for r in (0, 1)]
+    for r in rA:
+        assert r["status"] == "completed", r["status"]
+        assert r["final_step"] == steps_per_epoch, r["final_step"]
+        assert r["stats"]["rollbacks_total"] == 1, r["stats"]
+        assert _events(r, "rollback"), "no rollback event"
+    pA = [_load_params(d["outA"], 0, r) for r in (0, 1)]
+    pA0 = _load_params(d["outA0"], 0, 0)
+    _assert_params_equal(pA[0], pA[1], "armA rank0 vs rank1")
+    _assert_params_equal(pA[0], pA0, "armA vs no-fault control fleet")
+    lockstep_rollback = 1
+    print(f"[armA] PASS — one poisoned rank rolled BOTH ranks back "
+          f"(healthy rank too); final params bit-identical across ranks "
+          f"and to the control fleet "
+          f"(rollback: '{_events(rA[0], 'rollback')[0]['detail']}')")
+
+    # ====== Arm B: SIGKILL rank 1 -> detect, no partial ckpt, relaunch 1
+    print(f"\n[armB] 2-proc fleet, SIGKILL on rank 1 at step {KILL_STEP}; "
+          f"collective timeout {DETECT_TIMEOUT_S:.0f}s")
+    audit_state = {}
+
+    def audit(rec):
+        if not rec.peer_lost_ranks:
+            return
+        # between launches: the kill must leave the last COMPLETE
+        # checkpoint as the newest restorable one — the meta.json
+        # validity invariant (no partial save is ever restorable)
+        latest = find_latest_checkpoint(d["ckptB"])
+        assert latest is not None
+        step = int(os.path.basename(latest).split("_")[1])
+        last_full_ckpt = (KILL_STEP // CKPT_EVERY) * CKPT_EVERY
+        assert step == last_full_ckpt, (latest, last_full_ckpt)
+        audit_state["latest"] = latest
+        audit_state["meta"] = read_checkpoint_meta(latest)
+
+    resB = run_fleet("B", d["ckptB"], d["outB"],
+                     ["--kill-step", str(KILL_STEP), "--kill-rank", "1"],
+                     max_launches=3, audit=audit,
+                     timeout_s=DETECT_TIMEOUT_S)
+    assert resB.status == "completed", resB
+    assert resB.final_size == 1 and len(resB.launches) == 2, resB
+    first, second = resB.launches
+    assert first.peer_lost_ranks == [0], first.workers
+    assert first.workers[0].returncode == PEER_LOST_EXIT
+    assert first.workers[1].returncode < 0, first.workers  # signal death
+
+    # the survivor's view: peer named, detection timed, nothing saved
+    rB0 = _load_result(d["outB"], 0, 0)
+    assert rB0["status"] == "peer_lost"
+    assert rB0["peer_loss"]["lost_ranks"] == [1], rB0["peer_loss"]
+    detection_s = float(rB0["peer_loss"]["detection_s"])
+    assert rB0["stats"]["peer_losses_total"] == 1
+    peer_loss_detected = 1
+    flights = [p for p in glob.glob(os.path.join(d["ckptB"], "flight_*"))
+               if json.load(open(p)).get("reason") == "peer_lost"]
+    assert flights, "no peer_lost flight record"
+    print(f"[armB] survivor detected the loss in {detection_s:.1f}s, "
+          f"exited {PEER_LOST_EXIT} with flight record "
+          f"{os.path.basename(flights[0])}; launcher relaunched at "
+          f"size 1")
+
+    # the relaunched lone survivor: elastic restore + exact tiling
+    from deeplearning4j_tpu.datapipe.reshard import low_water_mark
+    low_water = low_water_mark(audit_state["meta"]["datapipe"])
+    ckpt_step = int(os.path.basename(
+        audit_state["latest"]).split("_")[1])
+    assert low_water == ckpt_step * GLOBAL_BATCH, (low_water, ckpt_step)
+    rB1 = _load_result(d["outB"], 1, 0)
+    assert rB1["status"] == "completed"
+    assert rB1["resumed_from"] == os.path.basename(audit_state["latest"])
+    reshard_events = _events(rB1, "reshard")
+    assert reshard_events, [e["kind"] for e in rB1["events"]]
+    assert rB1["seen"] == list(range(low_water, N_RECORDS)), (
+        rB1["seen"][:4], low_water)
+    assert rB1["final_step"] == steps_per_epoch, rB1["final_step"]
+    datapipe_exact = 1
+    print(f"[armB] relaunched run resumed {rB1['resumed_from']} onto "
+          f"1 process: reshard event '{reshard_events[0]['detail']}'; "
+          f"records [{low_water}, {N_RECORDS}) consumed exactly "
+          f"(low-water mark {low_water})")
+
+    # control: restore the same checkpoint on this (4-device) process
+    # and hand-replay the remainder — bit-identity standard
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from deeplearning4j_tpu.datapipe.reshard import remap_for
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.utils.checkpoint import \
+        restore_multi_layer_network
+    mesh4 = make_mesh({"data": len(jax.devices())})
+    net_c = restore_multi_layer_network(audit_state["latest"], mesh=mesh4)
+    seen_control: list = []
+    pipe_c = build_pipeline(*build_data(SEED), 1, 0, seen_control,
+                            GLOBAL_BATCH)
+    pipe_c.load_state_dict(
+        remap_for(pipe_c, audit_state["meta"]["datapipe"]))
+    for ds in pipe_c.stream(1):
+        net_c.fit_batch(ds)
+    assert seen_control == rB1["seen"]
+    _assert_params_equal(_load_params(d["outB"], 1, 0),
+                         flat_params(net_c),
+                         "armB survivor vs hand-replayed control")
+    bit_identical = 1
+    print("[armB] PASS — survivor's final params bit-identical to the "
+          "hand-replayed control")
+
+    # ========== Arm C: SIGTERM on one rank -> fleet-wide clean preempt
+    print(f"\n[armC] 2-proc fleet, SIGTERM on rank 1 at step "
+          f"{SIGTERM_STEP}")
+    resC = run_fleet("C", d["ckptC"], d["outC"],
+                     ["--sigterm-step", str(SIGTERM_STEP),
+                      "--sigterm-rank", "1"])
+    assert resC.status == "completed" and len(resC.launches) == 1, resC
+    rC = [_load_result(d["outC"], 0, r) for r in (0, 1)]
+    for r in rC:
+        assert r["status"] == "preempted", r["status"]
+        assert _events(r, "preempt"), "no preempt event"
+    assert rC[0]["final_step"] == rC[1]["final_step"], (
+        rC[0]["final_step"], rC[1]["final_step"])
+    latest_c = find_latest_checkpoint(d["ckptC"])
+    assert latest_c is not None and latest_c.endswith(
+        f"step_{rC[0]['final_step']}"), latest_c
+    # per-rank artifacts: rank 0 keeps the legacy names, rank 1 suffixed
+    assert os.path.exists(os.path.join(d["ckptC"], "run_report.json"))
+    assert os.path.exists(os.path.join(d["ckptC"], "run_report.r1.json"))
+    assert glob.glob(os.path.join(d["ckptC"], "flight_*.r1.json"))
+    preempt_broadcast = 1
+    print(f"[armC] PASS — SIGTERM on rank 1 stopped BOTH ranks at step "
+          f"{rC[0]['final_step']} with one barriered final checkpoint "
+          f"({os.path.basename(latest_c)}) and per-rank "
+          f"run_report/flight artifacts")
+
+    # ------------------------------------------------------------ receipt
+    receipt = {
+        "config": "cross_host",
+        "created_unix": round(time.time(), 2),
+        "fleet_size": 2, "total_devices": TOTAL_DEVICES,
+        "records": N_RECORDS, "steps_per_epoch": steps_per_epoch,
+        "lockstep_rollback": lockstep_rollback,
+        "bit_identical": bit_identical,
+        "peer_loss_detected": peer_loss_detected,
+        "detection_s": round(detection_s, 3),
+        "collective_timeout_s": DETECT_TIMEOUT_S,
+        "reshard_events": len(reshard_events),
+        "datapipe_exact": datapipe_exact,
+        "preempt_broadcast": preempt_broadcast,
+        "relaunches": resB.relaunches,
+        "final_fleet_size": resB.final_size,
+        "low_water_record": low_water,
+        "detail": {
+            "armA_rollback": _events(rA[0], "rollback")[0]["detail"],
+            "armB_peer_loss": rB0["peer_loss"],
+            "armB_reshard": reshard_events[0]["detail"],
+            "armB_resumed_from": rB1["resumed_from"],
+            "armC_final_step": rC[0]["final_step"],
+        },
+    }
+    print(f"\n[verdict] PASS — lockstep rollback, peer loss detected in "
+          f"{detection_s:.1f}s (limit {DETECT_TIMEOUT_S:.0f}s), elastic "
+          f"relaunch 2->1 bit-identical, SIGTERM broadcast clean")
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(receipt, fh, indent=1)
+        print(f"[receipt] {args.out}")
+    else:
+        print(json.dumps(receipt, indent=1))
+    if not args.dir:
+        shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

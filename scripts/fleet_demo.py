@@ -73,9 +73,12 @@ def run_worker(args) -> int:
     epochs = max(1, args.steps // (len(x) // 32))
     net.fit(x, y, epochs=epochs, batch_size=32)
     # push AFTER the fit so the snapshot carries real step counters;
-    # a second push proves last-write-wins replacement at the aggregator
+    # a second push proves last-write-wins replacement at the aggregator.
+    # attempts=5: an aggregator mid-restart costs a delayed heartbeat,
+    # not a permanently dropped worker
     for _ in range(2):
-        reply = dist.push_snapshot(args.push, health={"healthy": True})
+        reply = dist.push_snapshot(args.push, health={"healthy": True},
+                                   attempts=5)
         time.sleep(0.05)
     print(f"[worker {ident.instance}] pushed "
           f"(aggregator sees {reply['instances']} instance(s))")

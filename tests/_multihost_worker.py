@@ -113,6 +113,65 @@ def main():
                                                    averaging_frequency=2)
         trainer.fit(batches, window=2)
         assert trainer._local_steps == 5, trainer._local_steps
+    elif mode == "resilient":
+        # coordinated supervisor run with an env-driven fault plan: the
+        # cross-process recovery tests (lockstep NaN rollback, elastic
+        # 2->1 restore) drive this mode at several fleet sizes against
+        # ONE shared checkpoint dir. A record-id-tracking datapipe lets
+        # the parent audit exactly which records training consumed.
+        from deeplearning4j_tpu import datapipe
+        from deeplearning4j_tpu.resilience import (FaultInjector,
+                                                   SupervisorConfig,
+                                                   TrainingSupervisor)
+        env = os.environ
+        n_rec, global_batch = 32, 8
+        xg, yg = global_data(n=n_rec)
+        xg = xg.copy()
+        xg[:, 0] = np.arange(n_rec)     # record id in feature column 0
+        seen = []
+
+        def track(rec):
+            seen.append(int(round(float(rec[0][0]))))
+            return rec
+
+        pipe = (datapipe.from_arrays(xg, yg).shard(nproc, pid)
+                .map(track).batch(global_batch // nproc))
+        net.use_mesh(make_mesh({"data": len(jax.devices())}))
+
+        injector = FaultInjector()
+        if env.get("DL4J_TPU_TEST_POISON_STEP"):
+            injector.poison_step(
+                int(env["DL4J_TPU_TEST_POISON_STEP"]),
+                rank=int(env.get("DL4J_TPU_TEST_POISON_RANK", "0")))
+        if env.get("DL4J_TPU_TEST_PREEMPT_STEP"):
+            injector.preempt_at_step(
+                int(env["DL4J_TPU_TEST_PREEMPT_STEP"]),
+                rank=int(env.get("DL4J_TPU_TEST_PREEMPT_RANK", "0")))
+        cfg = SupervisorConfig(
+            checkpoint_dir=env["DL4J_TPU_TEST_CKPT"],
+            checkpoint_every_steps=2, keep_checkpoints=10,
+            backoff_initial_s=0.01, nan_lr_backoff=1.0,
+            handle_sigterm=False)
+        sup = TrainingSupervisor(net, cfg, injector=injector)
+        with injector.installed():
+            res = sup.fit_pipeline(pipe, epochs=1)
+
+        flat = {f"{ln}.{pn}": np.asarray(jax.device_get(arr))
+                for ln, sub in net.params.items()
+                for pn, arr in sub.items()}
+        np.savez(out_path,
+                 __status__=np.asarray(res.status),
+                 __final_step__=np.asarray(res.final_step),
+                 __rollbacks__=np.asarray(
+                     res.stats.get("rollbacks_total", 0)),
+                 __reshards__=np.asarray(
+                     res.stats.get("reshards_total", 0)),
+                 __resumed__=np.asarray(
+                     os.path.basename(res.resumed_from or "")),
+                 __seen__=np.asarray(seen, dtype=np.int64),
+                 **flat)
+        print("WORKER_OK", pid, res.status, res.final_step, flush=True)
+        return
     elif mode == "w2v":
         # multi-process embedding training (Word2VecPerformer.java:46
         # analogue): full-corpus vocab, strided shard, per-epoch averaging

@@ -1,0 +1,450 @@
+"""Cross-process resilience plumbing, tested without a real fleet.
+
+Covers the pieces ``scripts/chaos_multihost.py`` exercises end to end,
+at unit granularity: the consensus layer (``parallel.distributed``
+agree/any/barrier over a fake KV client, peer-loss timeout
+classification), idempotent ``initialize()``, the fleet launcher's
+monitor/shrink/straggler logic (plain ``python -c`` workers — the
+launcher never imports jax), per-rank artifact suffixes, the
+``push_snapshot`` retry/backoff opt-in, LocalSGD's dropped-batches
+accounting, and the ``cross_host`` budget gate on the committed chaos
+receipt. The real 2-process flows live in ``tests/test_multihost.py``
+(slow) and the chaos drill."""
+
+import json
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_budgets  # noqa: E402  (scripts/check_budgets.py)
+
+from deeplearning4j_tpu.parallel import distributed as dist  # noqa: E402
+from deeplearning4j_tpu.resilience.launcher import (  # noqa: E402
+    PEER_LOST_EXIT, FleetLauncher, free_port)
+
+
+# ---------------------------------------------------------------------------
+# consensus layer: single-process degenerate forms
+# ---------------------------------------------------------------------------
+
+def test_agree_decision_single_process_is_local():
+    assert dist.agree_decision(5) == [5]
+    assert dist.agree_decision(-3, name="nan") == [-3]
+
+
+def test_any_process_single_process():
+    assert dist.any_process(True) is True
+    assert dist.any_process(False) is False
+
+
+def test_barrier_single_process_is_noop():
+    dist.barrier("anything")  # must not touch any runtime
+
+
+def test_consensus_available_false_single_process():
+    assert dist.consensus_available() is False
+
+
+def test_collective_timeout_env(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_COLLECTIVE_TIMEOUT_S", raising=False)
+    assert dist.collective_timeout_s() == dist.DEFAULT_COLLECTIVE_TIMEOUT_S
+    monkeypatch.setenv("DL4J_TPU_COLLECTIVE_TIMEOUT_S", "7.5")
+    assert dist.collective_timeout_s() == 7.5
+    monkeypatch.setenv("DL4J_TPU_COLLECTIVE_TIMEOUT_S", "bogus")
+    assert dist.collective_timeout_s() == dist.DEFAULT_COLLECTIVE_TIMEOUT_S
+
+
+# ---------------------------------------------------------------------------
+# consensus layer: fake 2-process cluster over an in-memory KV client
+# ---------------------------------------------------------------------------
+
+class FakeKVClient:
+    """The coordination-service surface agree/barrier use, in-memory.
+    Peers are simulated by pre-seeding their keys; a missing key raises
+    like jaxlib's DEADLINE_EXCEEDED after the deadline."""
+
+    def __init__(self):
+        self.store = {}
+        self.deleted = []
+        self.barriers = []
+
+    def key_value_set(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.store:
+            return self.store[key]
+        time.sleep(min(timeout_ms, 20) / 1000.0)
+        raise RuntimeError(f"DEADLINE_EXCEEDED: {key}")
+
+    def key_value_delete(self, key):
+        self.deleted.append(key)
+        self.store.pop(key, None)
+
+    def wait_at_barrier(self, barrier_id, timeout_ms, *a, **k):
+        self.barriers.append(barrier_id)
+
+
+@pytest.fixture
+def fake_cluster(monkeypatch):
+    """A pretend 2-process rank-0 view: jax reports 2 processes, the
+    consensus layer talks to a FakeKVClient."""
+    import jax
+    client = FakeKVClient()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(dist, "_client", lambda: client)
+    monkeypatch.delenv("DL4J_TPU_INCARNATION", raising=False)
+    dist._reset_rounds()
+    yield client
+    dist._reset_rounds()
+
+
+def test_agree_decision_collects_peer_codes(fake_cluster):
+    fake_cluster.store["dl4j/agree/0/decision/0/1"] = "7"
+    assert dist.agree_decision(3) == [3, 7]
+    # our own code was published for the peer to read
+    assert fake_cluster.store["dl4j/agree/0/decision/0/0"] == "3"
+
+
+def test_agree_decision_rounds_are_per_name(fake_cluster):
+    fake_cluster.store["dl4j/agree/0/nan/0/1"] = "0"
+    fake_cluster.store["dl4j/agree/0/nan/1/1"] = "4"
+    fake_cluster.store["dl4j/agree/0/preempt/0/1"] = "1"
+    assert dist.agree_decision(0, name="nan") == [0, 0]
+    assert dist.agree_decision(9, name="nan") == [9, 4]
+    assert dist.agree_decision(0, name="preempt") == [0, 1]
+
+
+def test_agree_decision_gcs_own_key_two_rounds_back(fake_cluster):
+    for rnd in range(3):
+        fake_cluster.store[f"dl4j/agree/0/decision/{rnd}/1"] = "0"
+        dist.agree_decision(0)
+    assert "dl4j/agree/0/decision/0/0" in fake_cluster.deleted
+
+
+def test_dead_peer_raises_peer_lost_with_ranks(fake_cluster):
+    monkey_timeout = 0.2
+    with pytest.raises(dist.PeerLostError) as ei:
+        dist.agree_decision(1, name="step", timeout_s=monkey_timeout)
+    err = ei.value
+    assert err.lost_ranks == [1]
+    assert err.round_name == "step"
+    assert err.elapsed_s is not None and err.elapsed_s < 5.0
+    assert "presumed lost" in str(err)
+    # PeerLostError is a CollectiveTimeoutError is a RuntimeError
+    assert isinstance(err, dist.CollectiveTimeoutError)
+
+
+def test_any_process_true_when_any_peer_flags(fake_cluster):
+    fake_cluster.store["dl4j/agree/0/flag/0/1"] = "1"
+    assert dist.any_process(False) is True
+
+
+def test_barrier_uses_coordination_service(fake_cluster):
+    dist.barrier("ckpt_save_done")
+    assert fake_cluster.barriers == [
+        "dl4j/0/barrier/ckpt_save_done/0"]
+    dist.barrier("ckpt_save_done")   # next round, distinct id
+    assert fake_cluster.barriers[-1] == (
+        "dl4j/0/barrier/ckpt_save_done/1")
+
+
+def test_keys_are_incarnation_scoped(fake_cluster, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_INCARNATION", "3")
+    fake_cluster.store["dl4j/agree/3/decision/0/1"] = "2"
+    assert dist.agree_decision(1) == [1, 2]
+
+
+def test_consensus_without_client_raises(monkeypatch):
+    import jax
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "_client", lambda: None)
+    dist._reset_rounds()
+    with pytest.raises(RuntimeError, match="coordination"):
+        dist.agree_decision(1)
+    dist._reset_rounds()
+
+
+# ---------------------------------------------------------------------------
+# idempotent initialize()
+# ---------------------------------------------------------------------------
+
+def test_initialize_idempotent_warns_once(monkeypatch):
+    monkeypatch.setattr(dist, "_runtime_up", lambda: True)
+    monkeypatch.setattr(dist, "_ALREADY_UP_WARNED", False)
+    with pytest.warns(RuntimeWarning, match="already up"):
+        info = dist.initialize()
+    assert info["process_count"] >= 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # a second warning would raise
+        info2 = dist.initialize()
+    assert info2 == info
+
+
+# ---------------------------------------------------------------------------
+# fleet launcher (plain python -c workers — no jax involved)
+# ---------------------------------------------------------------------------
+
+def _sh(code):
+    def build_argv(size, rank, coordinator):
+        return [sys.executable, "-c", code]
+    return build_argv
+
+
+def test_launcher_clean_fleet_completes():
+    res = FleetLauncher(_sh("import sys; sys.exit(0)"),
+                        straggler_grace_s=5.0,
+                        launch_timeout_s=60.0).run(2)
+    assert res.status == "completed"
+    assert res.final_size == 2 and res.relaunches == 0
+    rec = res.launches[0]
+    assert rec.ok and rec.failed_ranks == [] and rec.peer_lost_ranks == []
+    assert all(w.returncode == 0 and w.duration_s is not None
+               for w in rec.workers)
+
+
+def test_launcher_shrinks_on_failure_until_success():
+    # workers fail whenever the fleet is larger than one process
+    code = ("import os, sys; "
+            "sys.exit(1 if int(os.environ['JAX_NUM_PROCESSES']) > 1 "
+            "else 0)")
+    res = FleetLauncher(_sh(code), min_size=1, max_launches=4,
+                        straggler_grace_s=1.0,
+                        launch_timeout_s=60.0).run(4)
+    assert res.status == "completed"
+    assert [rec.size for rec in res.launches] == [4, 2, 1]
+    assert res.final_size == 1 and res.relaunches == 2
+
+
+def test_launcher_classifies_peer_lost_exits():
+    code = ("import os, sys; "
+            f"sys.exit({PEER_LOST_EXIT} "
+            "if os.environ['JAX_PROCESS_ID'] == '0' else 7)")
+    rec = FleetLauncher(_sh(code), straggler_grace_s=1.0,
+                        launch_timeout_s=60.0).launch_once(2)
+    assert not rec.ok
+    assert rec.peer_lost_ranks == [0]
+    assert sorted(rec.failed_ranks) == [0, 1]
+    assert rec.workers[0].peer_lost and not rec.workers[1].peer_lost
+
+
+def test_launcher_kills_stragglers_after_grace():
+    # rank 0 dies instantly; rank 1 would sleep for a minute
+    code = ("import os, sys, time; "
+            "sys.exit(2) if os.environ['JAX_PROCESS_ID'] == '0' "
+            "else time.sleep(60)")
+    t0 = time.monotonic()
+    rec = FleetLauncher(_sh(code), straggler_grace_s=0.3,
+                        launch_timeout_s=60.0).launch_once(2)
+    assert time.monotonic() - t0 < 30.0
+    straggler = rec.workers[1]
+    assert straggler.killed and straggler.returncode not in (0, None)
+    assert rec.workers[0].returncode == 2 and not rec.workers[0].killed
+
+
+def test_launcher_keeps_global_device_count_constant():
+    # K = total_devices // size must land in each worker's XLA_FLAGS
+    code = ("import os, sys; "
+            "sys.exit(0 if '--xla_force_host_platform_device_count=2' "
+            "in os.environ.get('XLA_FLAGS', '') else 3)")
+    res = FleetLauncher(_sh(code), total_devices=4,
+                        straggler_grace_s=1.0,
+                        launch_timeout_s=60.0).run(2)
+    assert res.status == "completed", res.launches[0].workers
+
+
+def test_launcher_rejects_indivisible_device_count():
+    launcher = FleetLauncher(_sh("pass"), total_devices=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        launcher._worker_env(3, 0, 0)
+
+
+def test_launcher_env_identity(monkeypatch):
+    launcher = FleetLauncher(_sh("pass"), run_id="fleet-X",
+                             extra_env={"EXTRA": "1"})
+    env = launcher._worker_env(2, 1, 5)
+    assert env["DL4J_TPU_RUN_ID"] == "fleet-X"
+    assert env["DL4J_TPU_INSTANCE"] == "worker-1"
+    assert env["DL4J_TPU_INCARNATION"] == "5"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["EXTRA"] == "1"
+
+
+def test_free_port_is_bindable():
+    import socket
+    port = free_port()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", port))
+
+
+# ---------------------------------------------------------------------------
+# per-rank artifact suffixes
+# ---------------------------------------------------------------------------
+
+def test_rank_suffix_single_process_is_legacy():
+    from deeplearning4j_tpu.observability.distributed import rank_suffix
+    assert rank_suffix() == ""
+
+
+def test_rank_suffix_nonzero_rank(monkeypatch):
+    import jax
+    from deeplearning4j_tpu.observability.distributed import rank_suffix
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert rank_suffix() == ".r2"
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert rank_suffix() == ""      # rank 0 keeps the legacy names
+
+
+# ---------------------------------------------------------------------------
+# push_snapshot retry opt-in
+# ---------------------------------------------------------------------------
+
+class _FakeResponse:
+    def __init__(self, payload):
+        self._payload = payload
+
+    def read(self):
+        return json.dumps(self._payload).encode()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_push_snapshot_retries_then_succeeds(monkeypatch):
+    import urllib.request
+    from deeplearning4j_tpu.observability.distributed import push_snapshot
+    calls, sleeps = [], []
+
+    def fake_urlopen(req, timeout=None):
+        calls.append(req)
+        if len(calls) < 3:
+            raise OSError("connection refused")
+        return _FakeResponse({"ok": True})
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    out = push_snapshot("http://agg:9", attempts=5,
+                        backoff_initial_s=0.1, backoff_factor=2.0,
+                        jitter=0.0, sleep_fn=sleeps.append)
+    assert out == {"ok": True}
+    assert len(calls) == 3
+    assert sleeps == [0.1, 0.2]     # exponential, no jitter
+
+
+def test_push_snapshot_default_single_attempt_raises(monkeypatch):
+    import urllib.request
+    from deeplearning4j_tpu.observability.distributed import push_snapshot
+    sleeps = []
+
+    def fake_urlopen(req, timeout=None):
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    with pytest.raises(OSError):
+        push_snapshot("http://agg:9", sleep_fn=sleeps.append)
+    assert sleeps == []             # retry is strictly opt-in
+
+
+def test_push_snapshot_backoff_is_capped(monkeypatch):
+    import urllib.request
+    from deeplearning4j_tpu.observability.distributed import push_snapshot
+    sleeps = []
+    monkeypatch.setattr(
+        urllib.request, "urlopen",
+        lambda req, timeout=None: (_ for _ in ()).throw(OSError("down")))
+    with pytest.raises(OSError):
+        push_snapshot("http://agg:9", attempts=6, backoff_initial_s=1.0,
+                      backoff_factor=10.0, backoff_max_s=3.0, jitter=0.0,
+                      sleep_fn=sleeps.append)
+    assert sleeps == [1.0, 3.0, 3.0, 3.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# LocalSGD dropped-batches accounting
+# ---------------------------------------------------------------------------
+
+class _OneBatchNet:
+    """Minimal net surface for MultiProcessLocalSGD: unmeshed, params
+    live as a plain tree."""
+    params = {"l0": {"W": np.zeros(2)}}
+    opt_state = None
+
+    def fit_batch(self, ds):
+        return 0.0
+
+
+def test_localsgd_counts_dropped_batches(monkeypatch, caplog):
+    from jax.experimental import multihost_utils
+    from deeplearning4j_tpu.observability.metrics import get_registry
+
+    # pretend a peer ran out of data immediately: the allgathered counts
+    # come back [len(pending), 0] so the global minimum ends the epoch
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.asarray([int(arr), 0]))
+    trainer = dist.MultiProcessLocalSGD(_OneBatchNet())
+    before = trainer.dropped_batches
+    with caplog.at_level("WARNING", logger="deeplearning4j_tpu"):
+        trainer.fit(iter([object(), object(), object()]))
+    assert trainer.dropped_batches - before == 3
+    assert any("dropping 3 surplus" in r.message for r in caplog.records)
+    counter = get_registry().counter(
+        "dl4j_localsgd_dropped_batches_total")
+    assert counter.value >= 3
+
+
+def test_localsgd_no_drop_when_counts_even(monkeypatch):
+    from jax.experimental import multihost_utils
+    # single-process view: both the batch-count agreement and the
+    # parameter-averaging allgather see just this trainer's values
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.stack([np.asarray(arr)]))
+    trainer = dist.MultiProcessLocalSGD(_OneBatchNet())
+    trainer.fit(iter([object(), object()]))
+    assert trainer.dropped_batches == 0
+    assert trainer._local_steps == 2
+
+
+# ---------------------------------------------------------------------------
+# the cross_host budget gate on the committed chaos receipt
+# ---------------------------------------------------------------------------
+
+def test_crosshost_receipt_passes_budget_gate():
+    receipt = os.path.join(REPO, "CROSSHOST_r01.json")
+    if not os.path.exists(receipt):
+        pytest.skip("CROSSHOST_r01.json not generated yet "
+                    "(scripts/chaos_multihost.py)")
+    assert check_budgets.main(["--bench", receipt]) == 0
+
+
+def test_crosshost_budget_gate_rejects_regression(tmp_path):
+    bad = {"config": "cross_host", "bit_identical": 0,
+           "lockstep_rollback": 1, "peer_loss_detected": 1,
+           "detection_s": 5.0, "reshard_events": 1,
+           "datapipe_exact": 1, "preempt_broadcast": 1}
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(bad))
+    assert check_budgets.main(["--bench", str(path)]) == 1
+
+
+def test_crosshost_budget_gate_rejects_slow_detection(tmp_path):
+    bad = {"config": "cross_host", "bit_identical": 1,
+           "lockstep_rollback": 1, "peer_loss_detected": 1,
+           "detection_s": 4000.0, "reshard_events": 1,
+           "datapipe_exact": 1, "preempt_broadcast": 1}
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(bad))
+    assert check_budgets.main(["--bench", str(path)]) == 1
