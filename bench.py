@@ -450,6 +450,22 @@ def run_config(name: str) -> dict:
         # tokens/sec is the natural unit for the LSTM
         out["tokens_per_sec"] = round(out["examples_per_sec"] * 64, 1)
         return out
+    if name == "transformer":
+        # gpt_mini training fit: the attention-workload MFU entry
+        # (PERF.md §14). Per-step FLOPs come from the same XLA cost-model
+        # ledger as every other entry, so the published MFU is measured,
+        # not the 6*N*D estimate.
+        b, t, vocab = 8, 128, 80
+        ids = rng.integers(0, vocab, (b, t))
+        out = _bench_net(
+            zoo.gpt_mini(vocab_size=vocab, width=256, n_layers=4,
+                         n_heads=4, max_len=t),
+            np.eye(vocab, dtype=np.float32)[ids],
+            np.eye(vocab, dtype=np.float32)[
+                rng.integers(0, vocab, (b, t))],
+            scan_len=10, is_graph=False)
+        out["tokens_per_sec"] = round(out["examples_per_sec"] * t, 1)
+        return out
     if name == "serving":
         # inference-path throughput: the continuous-batching HTTP server
         # vs the lock-serialized per-request baseline, closed-loop
@@ -526,8 +542,8 @@ def _timed(fn) -> float:
 
 
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
-            "serving", "host_loop", "trace_overhead", "goodput_overhead",
-            "identity_overhead", "input_pipeline",
+            "transformer", "serving", "host_loop", "trace_overhead",
+            "goodput_overhead", "identity_overhead", "input_pipeline",
             "mixed_precision")
 
 

@@ -34,6 +34,8 @@ from deeplearning4j_tpu.datapipe.stages import (BatchStage, BucketBatchStage,
                                                 NormalizeStage,
                                                 NormalizerStats, ShardStage,
                                                 ShuffleStage)
+from deeplearning4j_tpu.datapipe.tokens import (CharTokenizer, TokenizeStage,
+                                                WindowStage)
 
 __all__ = [
     "Pipeline", "PipelineStats", "Stage",
@@ -41,7 +43,8 @@ __all__ = [
     "MapStage", "FilterStage", "NormalizeStage", "NormalizerStats",
     "ShuffleStage", "ShardStage", "BatchStage", "BucketBatchStage",
     "PrefetchStage",
-    "from_arrays", "from_csv", "from_lines", "from_records",
+    "CharTokenizer", "TokenizeStage", "WindowStage",
+    "from_arrays", "from_csv", "from_lines", "from_records", "from_text",
     "encode_record", "decode_record",
     "encode_state_value", "decode_state_value",
 ]
@@ -74,3 +77,19 @@ def from_records(record_reader, *, name: str = "datapipe") -> Pipeline:
     """Pipeline over any ``records.py``-style reader (``.records()``) or
     a plain sequence of record tuples."""
     return Pipeline(RecordSource(record_reader), name=name)
+
+
+def from_text(texts, *, name: str = "datapipe") -> Pipeline:
+    """Pipeline over text documents (a single string or a sequence of
+    strings), one ``(text,)`` record per document — the head of the
+    ``tokenize → window → bucket_batch`` language-model pipeline::
+
+        tok = datapipe.CharTokenizer.fit(corpus)
+        pipe = (datapipe.from_text(corpus)
+                .tokenize(tok)
+                .window(64, vocab_size=tok.vocab_size)
+                .bucket_batch(8))
+    """
+    if isinstance(texts, str):
+        texts = [texts]
+    return Pipeline(RecordSource([(t,) for t in texts]), name=name)

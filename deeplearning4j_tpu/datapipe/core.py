@@ -396,6 +396,22 @@ class Pipeline(DataSetIterator):
             stats = NormalizerStats.fit(self, eps=eps)
         return self._extend(NormalizeStage(self.tail, stats))
 
+    def tokenize(self, tokenizer) -> "Pipeline":
+        """Map text records to token-id records with a
+        ``tokens.CharTokenizer``-style tokenizer (``.encode(str)``)."""
+        from deeplearning4j_tpu.datapipe.tokens import TokenizeStage
+        return self._extend(TokenizeStage(self.tail, tokenizer))
+
+    def window(self, size: int, stride: Optional[int] = None,
+               vocab_size: Optional[int] = None) -> "Pipeline":
+        """Cut token-stream records into next-token training windows of
+        up to ``size`` steps (``(x_onehot, y_onehot)`` pairs when
+        ``vocab_size`` is given) — feed into ``bucket_batch`` for the
+        padded-length ladder."""
+        from deeplearning4j_tpu.datapipe.tokens import WindowStage
+        return self._extend(WindowStage(self.tail, size, stride=stride,
+                                        vocab_size=vocab_size))
+
     def shuffle(self, window: int = 1024, seed: int = 0) -> "Pipeline":
         """Windowed shuffle with an explicit seeded RNG (per-epoch RNG =
         ``seed + epoch``). Checkpoint state includes the RNG state and

@@ -11,4 +11,5 @@ from deeplearning4j_tpu.nn.conf.core import (
 from deeplearning4j_tpu.nn.conf import layers
 from deeplearning4j_tpu.nn.conf import layers_conv
 from deeplearning4j_tpu.nn.conf import layers_recurrent
+from deeplearning4j_tpu.nn.conf import layers_attention
 from deeplearning4j_tpu.nn.conf import layers_pretrain

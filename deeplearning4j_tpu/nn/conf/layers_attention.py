@@ -1,0 +1,108 @@
+"""Transformer-family layer configs (ROADMAP item 1 — the workload class
+the reference never had: no attention exists anywhere in its 28 config
+classes, PAPER.md §0).
+
+Layout follows the recurrent family: [batch, time, features], streaming
+state carried per layer under the same ``rnn_time_step`` contract that
+GravesLSTM uses for (h, c) — here the carries are the KV cache
+("k"/"v") and each row's absolute position ("pos"). ``max_cache_len``
+fixes the cache extent at prefill: the decode bit-identity contract
+(ops/attention.py docstring) requires prefill and every decode step to
+attend at the SAME kv length, so the cache is allocated once and never
+grows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import register_layer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    BaseRecurrentConfig,
+    RnnOutput,
+)
+
+
+@register_layer
+@dataclass(frozen=True)
+class GptEmbedding(BaseRecurrentConfig):
+    """Token + learned positional embedding: one-hot [b, t, vocab] ->
+    [b, t, n_out]. The token lookup is a gather (argmax over the one-hot,
+    EmbeddingLayer.java's mmul-shortcut rendered TPU-native); the
+    positional table is learned, ``max_len`` rows. Streaming carries
+    "pos" so decode steps index the positional table at each row's true
+    absolute offset."""
+
+    layer_type = "gpt_embedding"
+    max_len: int = 512
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.attention import GptEmbeddingLayer
+        return GptEmbeddingLayer(self, input_type, global_conf, policy)
+
+
+@dataclass(frozen=True)
+class BaseAttentionConfig(BaseRecurrentConfig):
+    """Shared shape inference for width-preserving attention layers:
+    n_out defaults to n_in (residual streams keep the model width)."""
+
+    layer_type = "base_attention"
+    n_heads: int = 4
+    max_cache_len: Optional[int] = None
+
+    def with_n_in(self, input_type: InputType):
+        c = super().with_n_in(input_type)
+        if c.n_out is None:
+            c = c.replace(n_out=c.n_in)
+        return c
+
+
+@register_layer
+@dataclass(frozen=True)
+class SelfAttention(BaseAttentionConfig):
+    """Causal multi-head self-attention: QKV projections (column-parallel
+    under tp_rules), the ``causal_mha`` registry op, and the output
+    projection (row-parallel). No residual/norm — compose those
+    explicitly, or use ``TransformerBlock`` for the standard pre-LN
+    block."""
+
+    layer_type = "self_attention"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+        return SelfAttentionLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class TransformerBlock(BaseAttentionConfig):
+    """Pre-LN transformer block (the GPT-2 arrangement):
+    ``x + attn(ln1(x))`` then ``a + mlp(ln2(a))`` with an
+    ``ffn_mult * width`` hidden MLP. ``activation`` (default gelu) is the
+    MLP nonlinearity; LayerNorm runs in f32 under any compute policy."""
+
+    layer_type = "transformer_block"
+    ffn_mult: int = 4
+    ln_eps: float = 1e-5
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.attention import (
+            TransformerBlockLayer)
+        return TransformerBlockLayer(self, input_type, global_conf, policy)
+
+
+@register_layer
+@dataclass(frozen=True)
+class GptOutput(RnnOutput):
+    """RnnOutput whose streaming preout uses the decode-stable exact
+    lowering (see nn/layers/attention.py docstring) — the head GPT models
+    must terminate in for the decode bit-identity contract to reach the
+    logits."""
+
+    layer_type = "gpt_output"
+
+    def make_layer(self, input_type, global_conf, policy):
+        from deeplearning4j_tpu.nn.layers.attention import GptOutputLayer
+        return GptOutputLayer(self, input_type, global_conf, policy)

@@ -1,0 +1,314 @@
+"""Transformer runtime layers: GPT embedding, causal self-attention, the
+pre-LN transformer block, and the streaming-exact output head.
+
+Streaming (`rnnTimeStep` parity, extended): where GravesLSTM carries
+(h, c), attention carries the KV cache ("k"/"v", [b, C, heads, dh] f32)
+and each row's absolute position ("pos", [b] int32). The cache is
+allocated ONCE at ``max_cache_len`` on the first streaming call and every
+subsequent call — prefill chunk or single decode token — attends against
+that full fixed extent, because the decode bit-identity contract
+(ops/attention.py docstring) only holds at a constant kv length.
+
+Two arithmetic paths, one tolerance seam:
+
+- **training / net.output()**: compute-dtype einsum projections (MXU
+  GEMMs) and the registry-resolved ``causal_mha`` (Pallas flash on TPU).
+- **streaming (prefill + decode)**: f32 multiply+reduce projections
+  (``_dense_exact``), f32 LayerNorm, and ``causal_mha_exact`` — every op
+  whose reduction order a GEMM would retile by shape is lowered as a
+  fused reduce instead, so a token's output is bit-identical whether it
+  was computed in a full-prompt prefill, a chunked prefill, or a
+  one-token decode step. Measured on this XLA: the einsum
+  ``btf,fg->btg`` itself moves by 1 ulp between t=1 and t=128 at
+  (1, 128, 256, 1024) f32, so exactness has to cover the projections and
+  the head, not just the attention op.
+
+The two paths agree to dtype tolerance (f32 ~1e-6 relative, bf16 ~1e-2)
+— the same two-tier contract PRECISION.md documents for serving, pinned
+in tests/test_transformer.py. Masked (right-padded) prefill is supported
+on the ONE-SHOT streaming call only: per-row true lengths come from the
+features mask, junk key slots beyond a row's length sit above "pos" and
+are overwritten by later decode steps before they ever become visible.
+Chunked prefill requires unmasked (aligned) rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayerImpl
+from deeplearning4j_tpu.ops import attention as att
+from deeplearning4j_tpu.ops import initializers as init_mod
+
+_DEFAULT_CACHE_LEN = 256
+
+
+def _layer_norm(x, g, b, eps):
+    """LayerNorm in f32 (returns f32). mean/variance lower as fused
+    reduces over the feature axis — shape-stable, measured."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    d = xf - mu
+    var = jnp.mean(d * d, axis=-1, keepdims=True)
+    y = d * jax.lax.rsqrt(var + float(eps))
+    return y * g.astype(jnp.float32) + b.astype(jnp.float32)
+
+
+def _dense_exact(x, W, b):
+    """[b, t, f] @ [f, g] as an explicit multiply+reduce in f32 — the
+    decode-stable lowering (module docstring). XLA fuses the broadcast
+    product into the reduce; nothing [b, t, f, g]-shaped reaches memory."""
+    out = jnp.sum(
+        x.astype(jnp.float32)[:, :, :, None]
+        * W.astype(jnp.float32)[None, None, :, :], axis=2)
+    if b is not None:
+        out = out + b.astype(jnp.float32)[None, None, :]
+    return out
+
+
+def _dense_gemm(x, W, b, cd):
+    """The throughput lowering: one compute-dtype GEMM."""
+    z = jnp.einsum("btf,fg->btg", x.astype(cd), W.astype(cd))
+    if b is not None:
+        z = z + b.astype(cd)
+    return z
+
+
+def _mask_lengths(mask, t):
+    """Per-row true length [b] int32 from a features mask (or None)."""
+    if mask is None:
+        return None
+    m = mask.reshape(mask.shape[0], -1)
+    return jnp.sum(m.astype(jnp.int32), axis=1)
+
+
+class GptEmbeddingLayer(Layer):
+    """One-hot [b, t, vocab] -> [b, t, d]: token gather + learned
+    positional table. Gathers are per-element exact, so this layer is
+    bit-stable in both paths by construction; streaming carries "pos" to
+    offset the positional lookup."""
+
+    is_recurrent_stateful = True
+    streaming = False
+
+    def init_params(self, key):
+        n_in, n_out = self.conf.n_in, self.conf.n_out
+        max_len = int(self.conf.max_len)
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
+        k1, k2 = jax.random.split(key)
+        return {
+            "Wtok": w_fn(k1, (n_in, n_out), n_in, n_out, self.param_dtype),
+            "Wpos": w_fn(k2, (max_len, n_out), max_len, n_out,
+                         self.param_dtype),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        b, t = x.shape[0], x.shape[1]
+        idx = jnp.argmax(x, axis=-1)                          # [b, t]
+        tok = jnp.take(params["Wtok"], idx, axis=0)           # param dtype
+        if self.streaming and "pos" in state:
+            p0 = state["pos"]
+        else:
+            p0 = jnp.zeros((b,), jnp.int32)
+        positions = p0[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        positions = jnp.clip(positions, 0, int(self.conf.max_len) - 1)
+        pos_emb = jnp.take(params["Wpos"], positions, axis=0)  # [b, t, d]
+        y = tok.astype(jnp.float32) + pos_emb.astype(jnp.float32)
+        new_state = dict(state)
+        if self.streaming:
+            lengths = _mask_lengths(mask, t)
+            new_state["pos"] = p0 + (t if lengths is None else lengths)
+            return y, new_state                               # f32, exact
+        return y.astype(self.compute_dtype), new_state
+
+
+class _AttentionCore(Layer):
+    """Shared QKV/output-projection + KV-cache machinery."""
+
+    is_recurrent_stateful = True
+    streaming = False
+
+    def __init__(self, conf, input_type, global_conf, policy):
+        super().__init__(conf, input_type, global_conf, policy)
+        d = int(conf.n_out)
+        heads = int(conf.n_heads)
+        if d % heads != 0:
+            raise ValueError(
+                f"{type(conf).__name__} '{conf.name}': n_out={d} not "
+                f"divisible by n_heads={heads}")
+        self.n_heads = heads
+        self.head_dim = d // heads
+
+    @property
+    def cache_len(self) -> int:
+        return int(self.resolve("max_cache_len", None) or _DEFAULT_CACHE_LEN)
+
+    def _init_attn_params(self, key):
+        d_in, d = int(self.conf.n_in), int(self.conf.n_out)
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
+        ks = jax.random.split(key, 4)
+        bias0 = float(self.resolve("bias_init", 0.0))
+        pd = self.param_dtype
+        return {
+            # column-parallel QKV (last axis shards on the model mesh
+            # axis), row-parallel output projection (first axis shards)
+            "Wq": w_fn(ks[0], (d_in, d), d_in, d, pd),
+            "Wk": w_fn(ks[1], (d_in, d), d_in, d, pd),
+            "Wv": w_fn(ks[2], (d_in, d), d_in, d, pd),
+            "Wo": w_fn(ks[3], (d, d), d, d, pd),
+            "bq": jnp.full((d,), bias0, pd),
+            "bk": jnp.full((d,), bias0, pd),
+            "bv": jnp.full((d,), bias0, pd),
+            "bo": jnp.full((d,), bias0, pd),
+        }
+
+    def _attn(self, params, state, h, mask):
+        """Apply MHA to ``h`` [b, t, d_in]; returns (proj [b, t, d],
+        carries-or-None). Streaming attends against the fixed-extent
+        cache; training runs the registry seam over the live sequence."""
+        b, t = h.shape[0], h.shape[1]
+        heads, dh, d = self.n_heads, self.head_dim, int(self.conf.n_out)
+        if self.streaming:
+            q = _dense_exact(h, params["Wq"], params["bq"])
+            k = _dense_exact(h, params["Wk"], params["bk"])
+            v = _dense_exact(h, params["Wv"], params["bv"])
+            q = q.reshape(b, t, heads, dh)
+            k = k.reshape(b, t, heads, dh)
+            v = v.reshape(b, t, heads, dh)
+            if "k" in state:
+                kc, vc, pos0 = state["k"], state["v"], state["pos"]
+            else:
+                C = self.cache_len
+                kc = jnp.zeros((b, C, heads, dh), jnp.float32)
+                vc = jnp.zeros((b, C, heads, dh), jnp.float32)
+                pos0 = jnp.zeros((b,), jnp.int32)
+            kc, vc = att.extend_cache(kc, vc, k, v, pos0)
+            out = att.causal_mha_exact(q, kc, vc, q_start=pos0)
+            lengths = _mask_lengths(mask, t)
+            new_pos = pos0 + (t if lengths is None else lengths)
+            proj = _dense_exact(out.reshape(b, t, d), params["Wo"],
+                                params["bo"])
+            return proj, {"k": kc, "v": vc, "pos": new_pos}
+        cd = h.dtype
+        q = _dense_gemm(h, params["Wq"], params["bq"], cd)
+        k = _dense_gemm(h, params["Wk"], params["bk"], cd)
+        v = _dense_gemm(h, params["Wv"], params["bv"], cd)
+        out = att.causal_mha(q.reshape(b, t, heads, dh),
+                             k.reshape(b, t, heads, dh),
+                             v.reshape(b, t, heads, dh))
+        proj = _dense_gemm(out.reshape(b, t, d), params["Wo"], params["bo"],
+                           cd)
+        return proj, None
+
+
+class SelfAttentionLayer(_AttentionCore):
+    """Bare causal MHA (projections + attention + output projection) —
+    no residual or norm; ``activation`` (default identity) applies to the
+    projected output."""
+
+    def init_params(self, key):
+        return self._init_attn_params(key)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._input_dropout(x, train, rng)
+        if self.streaming:
+            h = x.astype(jnp.float32)
+        else:
+            h = x.astype(self.compute_dtype)
+        proj, carries = self._attn(params, state, h, mask)
+        y = self.activation_fn(proj)
+        new_state = dict(state)
+        if carries:
+            new_state.update(carries)
+        return y, new_state
+
+    @property
+    def activation_fn(self):
+        from deeplearning4j_tpu.ops import activations as activations_mod
+        return activations_mod.get(self.resolve("activation", "identity"))
+
+
+class TransformerBlockLayer(_AttentionCore):
+    """Pre-LN block: ``a = x + attn(ln1(x))``, ``y = a + mlp(ln2(a))``.
+    Residual width is fixed (n_in == n_out enforced); LayerNorm always
+    runs in f32; the MLP nonlinearity is ``activation`` (gelu unless
+    overridden)."""
+
+    def __init__(self, conf, input_type, global_conf, policy):
+        super().__init__(conf, input_type, global_conf, policy)
+        if int(conf.n_in) != int(conf.n_out):
+            raise ValueError(
+                f"TransformerBlock '{conf.name}': residual stream needs "
+                f"n_in == n_out, got {conf.n_in} != {conf.n_out}")
+
+    @property
+    def activation_fn(self):
+        from deeplearning4j_tpu.ops import activations as activations_mod
+        return activations_mod.get(self.resolve("activation", "gelu"))
+
+    def init_params(self, key):
+        d = int(self.conf.n_out)
+        hidden = int(self.conf.ffn_mult) * d
+        w_fn = init_mod.resolve(self.resolve("weight_init", "xavier"))
+        k_attn, k1, k2 = jax.random.split(key, 3)
+        pd = self.param_dtype
+        bias0 = float(self.resolve("bias_init", 0.0))
+        params = self._init_attn_params(k_attn)
+        params.update({
+            "ln1_g": jnp.ones((d,), pd),
+            "ln1_b": jnp.zeros((d,), pd),
+            "ln2_g": jnp.ones((d,), pd),
+            "ln2_b": jnp.zeros((d,), pd),
+            # column-parallel up-projection, row-parallel down-projection
+            "W1": w_fn(k1, (d, hidden), d, hidden, pd),
+            "b1": jnp.full((hidden,), bias0, pd),
+            "W2": w_fn(k2, (hidden, d), hidden, d, pd),
+            "b2": jnp.full((d,), bias0, pd),
+        })
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        eps = float(self.conf.ln_eps)
+        x = self._input_dropout(x, train, rng)
+        if self.streaming:
+            xf = x.astype(jnp.float32)
+            h1 = _layer_norm(xf, params["ln1_g"], params["ln1_b"], eps)
+            proj, carries = self._attn(params, state, h1, mask)
+            a = xf + proj
+            h2 = _layer_norm(a, params["ln2_g"], params["ln2_b"], eps)
+            m = self.activation_fn(_dense_exact(h2, params["W1"],
+                                                params["b1"]))
+            y = a + _dense_exact(m, params["W2"], params["b2"])
+            new_state = dict(state)
+            new_state.update(carries)
+            return y, new_state
+        cd = self.compute_dtype
+        xc = x.astype(cd)
+        h1 = _layer_norm(xc, params["ln1_g"], params["ln1_b"], eps)
+        proj, _ = self._attn(params, state, h1.astype(cd), mask)
+        a = xc + proj
+        h2 = _layer_norm(a, params["ln2_g"], params["ln2_b"], eps)
+        m = self.activation_fn(
+            _dense_gemm(h2.astype(cd), params["W1"], params["b1"], cd))
+        y = a + _dense_gemm(m, params["W2"], params["b2"], cd)
+        return y, state
+
+
+class GptOutputLayer(RnnOutputLayerImpl):
+    """RnnOutput head whose STREAMING preout is the exact multiply+reduce
+    lowering — the final logits must be decode-stable too (the head einsum
+    alone moves by 1 ulp between t=1 and t=T, module docstring), and the
+    stock RnnOutput head keeps its einsum because the existing LSTM
+    rnn_time_step pin is calibrated against it."""
+
+    is_recurrent_stateful = True
+    streaming = False
+
+    def preout(self, params, x):
+        if self.streaming:
+            return _dense_exact(x.astype(jnp.float32), params["W"],
+                                params.get("b"))
+        return super().preout(params, x)

@@ -37,7 +37,9 @@ from deeplearning4j_tpu.ops import registry as ops
 
 del _lstm
 
-CARRY_KEYS = ("h", "c", "h_bwd", "c_bwd")
+# recurrent (h, c) carries plus the attention tier's KV-cache carries
+# (k/v caches + per-row absolute position — nn/layers/attention.py)
+CARRY_KEYS = ("h", "c", "h_bwd", "c_bwd", "k", "v", "pos")
 
 
 def _lstm_scan(params, x, h0, c0, mask, gate_act, cell_act):

@@ -482,10 +482,13 @@ class MultiLayerNetwork:
         """Reset streaming decode state (rnnClearPreviousState parity)."""
         self._rnn_state = None
 
-    def rnn_time_step(self, x):
+    def rnn_time_step(self, x, mask=None):
         """Stateful streaming inference (MultiLayerNetwork.rnnTimeStep :2234):
         feed one step [b, f] or a chunk [b, t, f]; recurrent layers carry
-        (h, c) across calls."""
+        (h, c) across calls — attention layers carry their KV cache and
+        per-row position. ``mask`` [b, t] marks real timesteps for
+        right-padded one-shot prefill (the attention layers advance each
+        row's position by its true length)."""
         self._require_init()
         x = jnp.asarray(x)
         single = x.ndim == 2
@@ -493,16 +496,21 @@ class MultiLayerNetwork:
             x = x[:, None, :]
         self._set_streaming(True)
         try:
-            key = "stream"
+            key = "stream" if mask is None else "stream_masked"
             if key not in self._apply_fns:
-                def fn(params, state, xx):
+                def fn(params, state, xx, fmask=None):
                     return self._forward(params, state, xx, train=False,
-                                         rng=None)
+                                         rng=None, fmask=fmask)
                 self._apply_fns[key] = jax.jit(fn)
             state_in = getattr(self, "_rnn_state", None)
             if state_in is None:
                 state_in = self.state
-            out, new_state = self._apply_fns[key](self.params, state_in, x)
+            if mask is None:
+                out, new_state = self._apply_fns[key](self.params, state_in,
+                                                      x)
+            else:
+                out, new_state = self._apply_fns[key](self.params, state_in,
+                                                      x, jnp.asarray(mask))
             self._rnn_state = new_state
         finally:
             self._set_streaming(False)

@@ -14,11 +14,15 @@ observability (serving/metrics.py). See SERVING.md.
 
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError)
+from deeplearning4j_tpu.serving.decode import (DecodeEngine, DecodeSession,
+                                               StreamingKVForward)
 from deeplearning4j_tpu.serving.fleet import Replica, ReplicaSet
+from deeplearning4j_tpu.serving.kvcache import CachePoolFullError, KVPagePool
 from deeplearning4j_tpu.serving.metrics import ServingStats
 from deeplearning4j_tpu.serving.server import (DeadlineExceededError,
                                                ModelServer, serve)
 
 __all__ = ["ModelServer", "serve", "MicroBatcher", "QueueFullError",
            "BatcherDeadError", "DeadlineExceededError", "ServingStats",
-           "Replica", "ReplicaSet"]
+           "Replica", "ReplicaSet", "DecodeEngine", "DecodeSession",
+           "StreamingKVForward", "KVPagePool", "CachePoolFullError"]

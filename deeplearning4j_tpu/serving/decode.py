@@ -1,0 +1,347 @@
+"""Autoregressive decode serving: prefill/decode phase split over the
+micro-batcher, session state in a paged KV pool, session-affine routing.
+
+The transformer streaming path (nn/layers/attention.py) is a pure
+function of (params, cache state, next tokens) — so decode serving rides
+the EXISTING batching runtime unchanged by making the per-session cache
+part of the ticket:
+
+- **Phase split for free.** ``MicroBatcher`` coalesces only tickets
+  whose per-input row shapes match. Prefill tickets are
+  ``[x [1, T, V], mask [1, T]]`` and decode tickets are ``[x [1, 1, V],
+  *cache leaves]`` — different arity and shapes, so the batcher's own
+  compatibility key IS the prefill/decode bucket split: decode steps
+  from many sessions coalesce into one bucket-B single-token forward,
+  prompts coalesce with same-length prompts, and neither phase ever
+  pads against the other.
+- **Prompt length ladder.** Prompts are right-padded (mask-marked) to a
+  power-of-two rung so nearby lengths share one compile AND one batch;
+  the one-shot masked prefill is bit-identical to feeding the prompt
+  token-by-token (the fixed-extent-cache contract, ops/attention.py),
+  so the padding is purely a throughput lever.
+- **State travels with the ticket.** Each session's cache leaves (per
+  layer: k/v [1, C, H, dh] f32 + pos [1] i32) are host rows concatenated
+  by the batcher exactly like features, and the forward returns the
+  advanced leaves which are sliced back per row. The forward itself
+  stays stateless → replicas stay interchangeable, and the fleet's
+  eviction/requeue machinery applies to decode tickets unchanged.
+- **Session affinity is a routing hint, not a correctness need.**
+  ``ReplicaSet.submit(..., session=sid)`` pins a session's steps to one
+  replica (warm jit cache, stable latency); on replica death the
+  affinity map rebinds and the ticket requeues — state rode the ticket,
+  so nothing is lost.
+- **Paged pool + recoverable eviction.** Between steps the leaves live
+  in a ``KVPagePool`` charged in ``page_tokens`` blocks; when the pool
+  evicts an idle session, its token history (kept here, tiny) is
+  re-prefilled on its next step — bit-identical recovery, counted in
+  ``reprefills``.
+
+Numeric contract (PRECISION.md / PERF.md §14): everything inside the
+streaming tier — prefill, chunk, step, pool round-trip, re-prefill after
+eviction — is BIT-IDENTICAL; streaming vs the training forward
+(``net.output``) carries the usual compute-dtype TOLERANCE contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+from deeplearning4j_tpu.serving.batcher import next_bucket
+from deeplearning4j_tpu.serving.fleet import ReplicaSet
+from deeplearning4j_tpu.serving.kvcache import KVPagePool
+
+__all__ = ["StreamingKVForward", "DecodeEngine", "DecodeSession"]
+
+
+class StreamingKVForward:
+    """Stateless feats-list forward over a streaming net, shaped for
+    ``MicroBatcher``: 2 inputs = prefill, 1 + n_carries inputs = decode.
+
+    Prefill ``[x [b,T,V], mask [b,T]]`` runs the masked one-shot
+    streaming forward from a fresh fixed-extent cache and returns
+    ``[last-real-token logits [b,V], *cache leaves]``. Decode
+    ``[x [b,1,V], *cache leaves]`` advances every row's cache one token
+    and returns ``[logits [b,V], *new leaves]``. Leaves flatten in
+    deterministic (sorted-key) pytree order; warm-up's float32 zero rows
+    are cast to each leaf's canonical dtype on entry so the jit cache
+    sees ONE signature per bucket.
+    """
+
+    def __init__(self, net):
+        from deeplearning4j_tpu.nn.layers.recurrent import (CARRY_KEYS,
+                                                            set_streaming)
+        self.net = net
+        self._carry_keys = CARRY_KEYS
+        self._set_streaming = set_streaming
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._jit_prefill = jax.jit(self._prefill_impl)
+        self._jit_decode = jax.jit(self._decode_impl)
+        self._carry_def = None
+        # eager 1-row probe pins the carry treedef + canonical dtypes
+        vocab = int(net.layers[0].conf.n_in)
+        self.vocab_size = vocab
+        self._enter()
+        try:
+            probe = self._prefill_impl(
+                net.params, net.state,
+                jnp.zeros((1, 1, vocab), jnp.float32),
+                jnp.ones((1, 1), jnp.float32))
+        finally:
+            self._exit()
+        self.n_carries = len(probe) - 1
+        self._carry_dtypes = [l.dtype for l in probe[1:]]
+        #: per-row shapes of the decode ticket's cache leaves (for warm)
+        self.carry_row_shapes = [tuple(l.shape[1:]) for l in probe[1:]]
+
+    # ------------------------------------------------- streaming-flag nesting
+    # replicas share this forward object AND the net; the layer streaming
+    # flag is read at trace time, so concurrent device threads must not
+    # see another thread's exit while they are still tracing
+    def _enter(self):
+        with self._lock:
+            self._depth += 1
+            if self._depth == 1:
+                self._set_streaming(self.net.layers, True)
+
+    def _exit(self):
+        with self._lock:
+            self._depth -= 1
+            if self._depth == 0:
+                self._set_streaming(self.net.layers, False)
+
+    # ------------------------------------------------------------- internals
+    def _extract(self, new_state):
+        carries = {}
+        for lname, sub in new_state.items():
+            c = {k: v for k, v in sub.items() if k in self._carry_keys}
+            if c:
+                carries[lname] = c
+        return carries
+
+    def _prefill_impl(self, params, state, x, mask):
+        out, ns = self.net._forward(params, state, x, train=False, rng=None,
+                                    fmask=mask)
+        lengths = jnp.maximum(
+            jnp.sum(mask.astype(jnp.int32), axis=1), 1)
+        logits = jnp.take_along_axis(
+            out, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        leaves, self._carry_def = jax.tree_util.tree_flatten(
+            self._extract(ns))
+        return [logits] + leaves
+
+    def _decode_impl(self, params, x, *leaves):
+        carries = jax.tree_util.tree_unflatten(self._carry_def, list(leaves))
+        state = {ln: dict(sub) for ln, sub in self.net.state.items()}
+        for ln, sub in carries.items():
+            merged = dict(state.get(ln, {}))
+            merged.update(sub)
+            state[ln] = merged
+        out, ns = self.net._forward(params, state, x, train=False, rng=None)
+        new_leaves, _ = jax.tree_util.tree_flatten(self._extract(ns))
+        return [out[:, 0, :]] + new_leaves
+
+    # ----------------------------------------------------------------- entry
+    def __call__(self, feats: list):
+        self._enter()
+        try:
+            if len(feats) == 2:
+                out = self._jit_prefill(
+                    self.net.params, self.net.state,
+                    jnp.asarray(feats[0], jnp.float32),
+                    jnp.asarray(feats[1], jnp.float32))
+            else:
+                leaves = [jnp.asarray(f, dt)
+                          for f, dt in zip(feats[1:], self._carry_dtypes)]
+                out = self._jit_decode(
+                    self.net.params, jnp.asarray(feats[0], jnp.float32),
+                    *leaves)
+        finally:
+            self._exit()
+        return [np.asarray(o) for o in out]
+
+
+class DecodeSession:
+    """Host-side session record: token history (tiny ints — the recovery
+    source after a pool eviction) + bookkeeping. The heavy cache leaves
+    live in the ``KVPagePool``."""
+
+    __slots__ = ("sid", "ids", "created", "last_step")
+
+    def __init__(self, sid: str, ids: List[int]):
+        self.sid = sid
+        self.ids = list(ids)
+        self.created = time.time()
+        self.last_step = self.created
+
+    @property
+    def tokens(self) -> int:
+        return len(self.ids)
+
+
+class DecodeEngine:
+    """Sessionful autoregressive decode over a ``ReplicaSet``.
+
+    ``prefill(sid, ids)`` admits a session (one-shot masked prompt
+    forward, cache leaves into the pool) and returns next-token logits;
+    ``step(sid, token)`` extends it one token. Both are synchronous per
+    session; cross-session throughput comes from the batcher's window
+    coalescing concurrent sessions' single-token steps into one bucket
+    forward (drive sessions from threads, as ``serve_bench --decode``
+    does).
+    """
+
+    def __init__(self, net, *, replicas: int = 1, pool: KVPagePool = None,
+                 n_pages: int = 256, page_tokens: int = 16,
+                 max_batch: int = 64, batch_window_ms: float = 2.0,
+                 max_queue: int = 1024, min_batch: int = 2,
+                 min_prompt_bucket: int = 8, stats=None):
+        self.forward = StreamingKVForward(net)
+        self.fleet = ReplicaSet(self.forward, replicas, max_batch=max_batch,
+                                batch_window_ms=batch_window_ms,
+                                max_queue=max_queue, min_batch=min_batch,
+                                stats=stats)
+        self.pool = pool if pool is not None \
+            else KVPagePool(n_pages, page_tokens)
+        self.min_prompt_bucket = int(min_prompt_bucket)
+        self.max_prompt = self._max_prompt(net)
+        self._sessions: Dict[str, DecodeSession] = {}
+        self._lock = threading.Lock()
+        self.prefills = 0
+        self.decode_steps = 0
+        self.reprefills = 0   # evicted sessions re-admitted from history
+
+    @staticmethod
+    def _max_prompt(net) -> int:
+        caps = [int(getattr(ly, "cache_len", 0) or 0) for ly in net.layers]
+        caps = [c for c in caps if c > 0]
+        return min(caps) if caps else 256
+
+    # --------------------------------------------------------------- helpers
+    def _one_hot(self, ids: Sequence[int], t: int) -> np.ndarray:
+        x = np.zeros((1, t, self.forward.vocab_size), np.float32)
+        for j, i in enumerate(ids):
+            x[0, j, int(i)] = 1.0
+        return x
+
+    def _prompt_bucket(self, t: int) -> int:
+        return next_bucket(t, self.max_prompt, self.min_prompt_bucket)
+
+    def warm(self):
+        """Precompile both phase ladders: the decode bucket ladder (the
+        latency-critical one) and the prefill ladder for every prompt
+        rung."""
+        v = self.forward.vocab_size
+        compiled = list(self.fleet.warm(
+            [(1, v)] + list(self.forward.carry_row_shapes)))
+        t = self.min_prompt_bucket
+        rungs = []
+        while t < self.max_prompt:
+            rungs.append(t)
+            t *= 2
+        rungs.append(self.max_prompt)   # next_bucket caps at the extent
+        for t in rungs:
+            compiled += self.fleet.warm([(t, v), (t,)])
+        return compiled
+
+    # ------------------------------------------------------------- lifecycle
+    def _run_prefill(self, sid: str, ids: List[int]) -> np.ndarray:
+        t = len(ids)
+        if t < 1:
+            raise ValueError("prefill needs at least one prompt token")
+        if t > self.max_prompt:
+            raise ValueError(f"prompt of {t} tokens exceeds the cache "
+                             f"extent {self.max_prompt}")
+        bt = self._prompt_bucket(t)
+        x = self._one_hot(ids, bt)
+        mask = np.zeros((1, bt), np.float32)
+        mask[0, :t] = 1.0
+        res = self.fleet.submit([x, mask], session=sid).result()
+        logits, leaves = res[0], list(res[1:])
+        self.pool.put(sid, t, leaves)
+        return logits[0], leaves
+
+    def prefill(self, sid: str, ids: Sequence[int]) -> np.ndarray:
+        """Admit session ``sid`` with prompt token ids; returns the
+        next-token logits row [V]."""
+        ids = [int(i) for i in ids]
+        with self._lock:
+            self._sessions[sid] = DecodeSession(sid, ids)
+            self.prefills += 1
+        return self._run_prefill(sid, ids)[0]
+
+    def step(self, sid: str, token: int) -> np.ndarray:
+        """Feed one decoded token into session ``sid``; returns the
+        next-token logits row [V]. Transparently re-prefills from token
+        history when the pool evicted this session between steps."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None:
+            raise KeyError(f"unknown decode session '{sid}'")
+        if sess.tokens + 1 > self.max_prompt:
+            raise ValueError(f"session '{sid}' is at the cache extent "
+                             f"{self.max_prompt}")
+        leaves = self.pool.get(sid)
+        if leaves is None:
+            # evicted between steps: recover from history — the one-shot
+            # re-prefill is bit-identical to the steps it replaces
+            with self._lock:
+                self.reprefills += 1
+            leaves = self._run_prefill(sid, sess.ids)[1]
+        x = self._one_hot([token], 1)
+        res = self.fleet.submit([x] + list(leaves), session=sid).result()
+        logits, new_leaves = res[0], res[1:]
+        sess.ids.append(int(token))
+        sess.last_step = time.time()
+        with self._lock:
+            self.decode_steps += 1
+        self.pool.put(sid, sess.tokens, new_leaves)
+        return logits[0]
+
+    def generate(self, sid: str, ids: Sequence[int], n_tokens: int,
+                 *, step_times: Optional[list] = None) -> List[int]:
+        """Greedy decode: prefill then ``n_tokens`` argmax steps. Returns
+        the generated ids; ``step_times`` (if given) collects per-step
+        wall seconds — the inter-token latency sample stream."""
+        logits = self.prefill(sid, ids)
+        out = []
+        nxt = int(np.argmax(logits))
+        for _ in range(int(n_tokens)):
+            out.append(nxt)
+            t0 = time.perf_counter()
+            logits = self.step(sid, nxt)
+            if step_times is not None:
+                step_times.append(time.perf_counter() - t0)
+            nxt = int(np.argmax(logits))
+        return out
+
+    def close_session(self, sid: str) -> bool:
+        with self._lock:
+            known = self._sessions.pop(sid, None) is not None
+        self.pool.drop(sid)
+        self.fleet.forget_session(sid)
+        return known
+
+    # ----------------------------------------------------------------- state
+    @property
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return list(self._sessions)
+
+    def describe(self) -> dict:
+        d = self.pool.describe()
+        d.update(prefills=self.prefills, decode_steps=self.decode_steps,
+                 reprefills=self.reprefills,
+                 affinity_hits=self.fleet.affinity_hits,
+                 affinity_misses=self.fleet.affinity_misses,
+                 sessions_live=len(self._sessions))
+        return d
+
+    def stop(self):
+        self.fleet.stop()

@@ -32,6 +32,14 @@ one coalesced bucket forward — and scales it horizontally:
   DISTINCT forward object, not once per replica. N replicas over one
   model/mesh pay one ladder (asserted via the compile-count metric —
   ``dl4j_xla_compile_total`` is flat in N).
+- **Session affinity** (decode serving, serving/decode.py): a ticket
+  submitted with ``session=sid`` sticks to the replica that served the
+  session last — decode steps hit a warm jit cache and stable queue
+  instead of ping-ponging. Affinity is a ROUTING HINT layered on the
+  least-depth picker, never a correctness dependency: the session's
+  cache state rides the ticket itself, so when the pinned replica dies
+  or drains the map rebinds to the least-depth survivor (an
+  ``affinity_miss``) and the requeue machinery above applies unchanged.
 
 All replicas share one ``ServingStats`` (counters are lock-guarded) and
 one ``shapes_seen`` set (the compile-cache footprint is a property of
@@ -95,6 +103,9 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._rr = 0          # round-robin tiebreak cursor
         self.requeued = 0     # tickets resubmitted after an eviction
+        self._affinity = {}   # session id -> pinned Replica
+        self.affinity_hits = 0
+        self.affinity_misses = 0
         #: the width this tier was PROVISIONED at: a fleet restarted on
         #: fewer devices keeps serving but reports degraded until a
         #: later restart restores the original width
@@ -245,29 +256,47 @@ class ReplicaSet:
             self.replicas = [Replica(i, self._make_batcher(f))
                              for i, f in enumerate(forwards)]
             self._rr = 0
+            self._affinity.clear()   # old Replica objects are gone
         if self.stats is not None:
             self.stats.queue_depth_fn = self.total_depth
         return self
 
     # --------------------------------------------------------------- routing
-    def _pick(self) -> Optional[Replica]:
+    def _pick(self, session=None) -> Optional[Replica]:
         with self._lock:
             self._sweep_dead_locked()
             live = [r for r in self.replicas if r.status == LIVE]
             if not live:
                 return None
+            if session is not None:
+                pinned = self._affinity.get(session)
+                if pinned is not None and pinned.status == LIVE:
+                    self.affinity_hits += 1
+                    return pinned
+                # first sighting, or the pinned replica died/drained —
+                # rebind below to the least-depth pick
+                self.affinity_misses += 1
             depths = [r.depth for r in live]
             lo = min(depths)
             tied = [r for r, d in zip(live, depths) if d == lo]
             pick = tied[self._rr % len(tied)]
             self._rr += 1
+            if session is not None:
+                self._affinity[session] = pick
             return pick
 
-    def submit(self, feats: list, trace_id: str = None) -> Future:
+    def forget_session(self, session):
+        """Drop a closed session's routing pin (decode.close_session)."""
+        with self._lock:
+            self._affinity.pop(session, None)
+
+    def submit(self, feats: list, trace_id: str = None,
+               session=None) -> Future:
         """Admit one ticket fleet-wide and route it to the shallowest
-        live queue. Raises ``QueueFullError`` when the SUM of replica
-        depths is at ``max_queue`` (global backpressure), and
-        ``BatcherDeadError`` only when no live replica remains."""
+        live queue — or, with ``session=``, to the session's pinned
+        replica while it stays live. Raises ``QueueFullError`` when the
+        SUM of replica depths is at ``max_queue`` (global backpressure),
+        and ``BatcherDeadError`` only when no live replica remains."""
         self.start()
         if self.total_depth() >= self.max_queue:
             if self.stats is not None:
@@ -277,12 +306,13 @@ class ReplicaSet:
                 f"{len(self.replicas)} replicas (max_queue="
                 f"{self.max_queue})")
         outer = Future()
-        self._dispatch(feats, trace_id, outer, first=True)
+        self._dispatch(feats, trace_id, outer, first=True, session=session)
         return outer
 
-    def _dispatch(self, feats, trace_id, outer: Future, first: bool):
+    def _dispatch(self, feats, trace_id, outer: Future, first: bool,
+                  session=None):
         while True:
-            r = self._pick()
+            r = self._pick(session)
             if r is None:
                 err = BatcherDeadError("all replicas dead")
                 if first:
@@ -307,11 +337,11 @@ class ReplicaSet:
                 return
             inner.add_done_callback(
                 lambda f, rep=r: self._on_done(f, rep, feats, trace_id,
-                                               outer))
+                                               outer, session))
             return
 
     def _on_done(self, inner: Future, replica: Replica, feats, trace_id,
-                 outer: Future):
+                 outer: Future, session=None):
         exc = inner.exception()
         if exc is None:
             outer.set_result(inner.result())
@@ -319,9 +349,11 @@ class ReplicaSet:
             # the replica died with this ticket in flight; its future
             # was failed by _die BEFORE any result delivery, so a
             # resubmit cannot double-deliver — requeue onto survivors
+            # (a pinned session rebinds in _pick: the pin is dead)
             self._mark_dead(replica)
             with self._lock:
                 self.requeued += 1
-            self._dispatch(feats, trace_id, outer, first=False)
+            self._dispatch(feats, trace_id, outer, first=False,
+                           session=session)
         else:
             outer.set_exception(exc)

@@ -1,0 +1,127 @@
+"""Paged KV-cache pool: block-granular admission + LRU eviction for
+decode sessions.
+
+Autoregressive decode serving holds per-session state (each transformer
+layer's KV cache plus positions) between requests — unbounded sessions
+would grow that footprint without limit. This pool is the admission
+tier: capacity is fixed in PAGES of ``page_tokens`` tokens each, every
+session is charged ``ceil(tokens / page_tokens)`` pages for the prefix
+it has decoded so far, and when an allocation would overflow the pool
+the least-recently-used *other* session is evicted — its cached state is
+dropped and its pages return to the free pool.
+
+Eviction is RECOVERABLE, mirroring the replica tier's requeue stance
+(fleet.py): the decode engine keeps each session's token history (ints —
+thousands of times smaller than the KV tensors), so an evicted session
+that comes back is transparently re-prefilled from history before its
+next step. The session sees extra latency, never a wrong token: one-shot
+prefill is bit-identical to the step-by-step path it replaces
+(tests/test_transformer.py pins this), so recovery is invisible in the
+output stream.
+
+The pool stores each session's cache leaves verbatim (dense per-session
+tensors, host-side numpy rows); "paged" here is the ACCOUNTING contract
+— block-granular occupancy and eviction à la paged attention — not
+physical page sharing between sessions. Occupancy (`pages_used /
+n_pages`) and the eviction counter feed ``serve_bench --decode`` and the
+metrics registry.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List
+
+__all__ = ["KVPagePool", "CachePoolFullError"]
+
+
+class CachePoolFullError(RuntimeError):
+    """A single session needs more pages than the whole pool holds —
+    admission must reject it (no amount of eviction can fit it)."""
+
+
+class KVPagePool:
+    """Fixed-capacity page accounting + LRU store for decode-session
+    cache state.
+
+    ``put`` charges/extends a session and stores its cache leaves,
+    evicting least-recently-used other sessions as needed; ``get``
+    retrieves (and LRU-touches) them; a ``get`` returning ``None`` means
+    the session was evicted and must be re-prefilled from history.
+    """
+
+    def __init__(self, n_pages: int = 256, page_tokens: int = 16):
+        if n_pages < 1 or page_tokens < 1:
+            raise ValueError("n_pages and page_tokens must be >= 1")
+        self.n_pages = int(n_pages)
+        self.page_tokens = int(page_tokens)
+        self._lock = threading.Lock()
+        # sid -> (pages_held, cache leaves); insertion order = LRU order
+        self._table: OrderedDict[str, tuple] = OrderedDict()
+        self.evictions = 0          # sessions dropped to free pages
+        self.evicted_pages = 0      # pages reclaimed by those drops
+
+    # ------------------------------------------------------------ accounting
+    def pages_for(self, tokens: int) -> int:
+        return max(1, -(-int(tokens) // self.page_tokens))
+
+    @property
+    def pages_used(self) -> int:
+        with self._lock:
+            return sum(p for p, _ in self._table.values())
+
+    @property
+    def occupancy(self) -> float:
+        return self.pages_used / self.n_pages
+
+    @property
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return list(self._table)
+
+    def describe(self) -> dict:
+        with self._lock:
+            used = sum(p for p, _ in self._table.values())
+            return {"n_pages": self.n_pages, "page_tokens": self.page_tokens,
+                    "pages_used": used, "occupancy": used / self.n_pages,
+                    "sessions": len(self._table),
+                    "evictions": self.evictions}
+
+    # ----------------------------------------------------------------- store
+    def put(self, sid: str, tokens: int, leaves) -> None:
+        """Store/refresh ``sid``'s cache leaves and charge it for
+        ``tokens`` decoded tokens, evicting LRU peers if the pool is
+        full. Raises ``CachePoolFullError`` when the session alone
+        exceeds pool capacity."""
+        need = self.pages_for(tokens)
+        if need > self.n_pages:
+            raise CachePoolFullError(
+                f"session '{sid}' needs {need} pages "
+                f"({tokens} tokens @ {self.page_tokens}/page) but the "
+                f"pool holds {self.n_pages}")
+        with self._lock:
+            self._table.pop(sid, None)   # re-charge at the new token count
+            used = sum(p for p, _ in self._table.values())
+            while used + need > self.n_pages:
+                _victim, (vpages, _) = self._table.popitem(last=False)
+                self.evictions += 1
+                self.evicted_pages += vpages
+                used -= vpages
+            self._table[sid] = (need, leaves)
+
+    def get(self, sid: str):
+        """Cache leaves for ``sid`` (LRU-touched), or ``None`` if the
+        session was evicted (caller re-prefills from token history)."""
+        with self._lock:
+            entry = self._table.pop(sid, None)
+            if entry is None:
+                return None
+            self._table[sid] = entry   # move to MRU end
+            return entry[1]
+
+    def drop(self, sid: str) -> bool:
+        """Voluntary release (session closed) — frees its pages without
+        counting as an eviction."""
+        with self._lock:
+            return self._table.pop(sid, None) is not None

@@ -7,6 +7,8 @@ from deeplearning4j_tpu.zoo.models import (
     F32,
     VGG16_MEAN_RGB,
     char_rnn,
+    gpt_mini,
+    gpt_mini_tp_rules,
     lenet,
     mnist_mlp,
     resnet18,
@@ -15,6 +17,6 @@ from deeplearning4j_tpu.zoo.models import (
     vgg16_preprocess,
 )
 
-__all__ = ["BF16", "F32", "VGG16_MEAN_RGB", "char_rnn", "lenet",
-           "mnist_mlp", "resnet18", "resnet50", "vgg16",
-           "vgg16_preprocess"]
+__all__ = ["BF16", "F32", "VGG16_MEAN_RGB", "char_rnn", "gpt_mini",
+           "gpt_mini_tp_rules", "lenet", "mnist_mlp", "resnet18",
+           "resnet50", "vgg16", "vgg16_preprocess"]

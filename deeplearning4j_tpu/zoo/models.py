@@ -207,6 +207,50 @@ def char_rnn(vocab_size: int = 80, hidden: int = 512, n_layers: int = 2,
     return MultiLayerNetwork(conf).init()
 
 
+def gpt_mini(vocab_size: int = 80, width: int = 256, n_layers: int = 4,
+             n_heads: int = 4, max_len: int = 256,
+             max_cache_len: Optional[int] = None, seed: int = 42,
+             dtype: Optional[DtypePolicy] = None) -> MultiLayerNetwork:
+    """GPT-style decoder-only LM (ROADMAP item 1's workload): one-hot
+    tokens -> GptEmbedding (learned positions) -> ``n_layers`` pre-LN
+    TransformerBlocks -> streaming-exact softmax head. Serving decode
+    carries a fixed-extent KV cache of ``max_cache_len`` (defaults to
+    ``max_len``) per block — see nn/layers/attention.py for the decode
+    bit-identity contract."""
+    from deeplearning4j_tpu.nn.conf.layers_attention import (
+        GptEmbedding, GptOutput, TransformerBlock)
+    cache = int(max_cache_len or max_len)
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(3e-4)).dtype(dtype or BF16)
+         .list()
+         .layer(GptEmbedding(n_out=width, max_len=max_len)))
+    for _ in range(n_layers):
+        b = b.layer(TransformerBlock(n_heads=n_heads, activation="gelu",
+                                     max_cache_len=cache))
+    conf = (b.layer(GptOutput(n_out=vocab_size, loss="mcxent",
+                              activation="softmax"))
+            .set_input_type(InputType.recurrent(vocab_size))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def gpt_mini_tp_rules():
+    """Tensor-parallel placement for ``gpt_mini`` (regex form,
+    parallel/tensor.py match semantics, first match wins): column-parallel
+    QKV + MLP up-projection (last axis on "model"), row-parallel output
+    projection + MLP down-projection (first axis on "model"); embeddings
+    and the LM head shard column-wise; norms/biases replicate via the
+    default rule."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"\['W[qkv]'\]", P(None, "model")),
+        (r"\['W1'\]", P(None, "model")),
+        (r"\['Wo'\]", P("model", None)),
+        (r"\['W2'\]", P("model", None)),
+        (r"\['W(tok|pos)'\]", P(None, "model")),
+    ]
+
+
 def vgg16(seed: int = 42, n_classes: int = 1000, image_size: int = 224,
           dtype: Optional[DtypePolicy] = None,
           updater=None) -> MultiLayerNetwork:

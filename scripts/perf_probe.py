@@ -30,7 +30,7 @@ def main():
                                        "mnist_mlp", "resnet18", "host_loop",
                                        "trace_overhead", "goodput_overhead",
                                        "input_pipeline", "mixed_precision",
-                                       "serving"])
+                                       "serving", "transformer"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
@@ -111,6 +111,24 @@ def main():
         finish(out)
         return
 
+    if args.config == "transformer" and args.serving_results:
+        # summarize an existing serve_bench.py --decode --out receipt
+        # (TRANSFORMER_r01.json) — the decode-serving half of the
+        # transformer round; without --serving-results this config falls
+        # through to the gpt_mini training-step probe below
+        out = {"config": "transformer"}
+        with open(args.serving_results) as f:
+            rep = json.load(f)
+        out["results_file"] = args.serving_results
+        for k in ("model", "decode_tokens_per_sec", "inter_token_p50_ms",
+                  "inter_token_p99_ms", "decode_bit_identical",
+                  "kv_pool_occupancy", "kv_evictions", "reprefills",
+                  "affinity_hit_rate", "train_mfu", "train_tokens_per_sec"):
+            if k in rep:
+                out[k] = rep[k]
+        finish(out)
+        return
+
     if args.config == "input_pipeline":
         # the datapipe round: records/sec + stall fraction through a
         # shuffle/batch/prefetch pipeline vs the bare in-memory gather,
@@ -170,6 +188,17 @@ def main():
         net = zoo.mnist_mlp(dtype=dtype)
         x = rng.normal(size=(args.batch, 784)).astype(np.float32)
         y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, args.batch)]
+    elif args.config == "transformer":
+        # gpt_mini training step (the bench.py `transformer` shape);
+        # --seq sets the window, default batch drops to 8
+        b = args.batch if args.batch != 256 else 8
+        t = args.seq if args.seq != 64 else 128
+        args.batch = b
+        net = zoo.gpt_mini(vocab_size=80, width=256, n_layers=4,
+                           n_heads=4, max_len=t, dtype=dtype)
+        ids = rng.integers(0, 80, (b, t))
+        x = np.eye(80, dtype=np.float32)[ids]
+        y = np.eye(80, dtype=np.float32)[rng.integers(0, 80, (b, t))]
     else:
         net = zoo.char_rnn(vocab_size=80, hidden=args.hidden, n_layers=2,
                            dtype=dtype)
@@ -192,6 +221,9 @@ def main():
         "scan_len": n,
         "bench_wall_s": round(total, 1),
     }
+    if args.config in ("char_rnn", "transformer"):
+        out["tokens_per_sec"] = round(
+            args.batch * x.shape[1] / sec_per_step, 1)
 
     # cost analysis of the single fused step
     try:

@@ -314,6 +314,142 @@ def _platform() -> str:
         return "unknown"
 
 
+# ------------------------------------------------------------ decode bench
+def bench_decode(sessions: int = 12, gen_tokens: int = 24,
+                 replicas: int = 2, n_pages: int = 24,
+                 page_tokens: int = 16, max_batch: int = 16,
+                 batch_window_ms: float = 2.0, vocab: int = 32,
+                 width: int = 64, n_layers: int = 2, n_heads: int = 4,
+                 max_cache_len: int = 128) -> dict:
+    """Sessionful decode serving load (config ``transformer``):
+    ``sessions`` concurrent greedy-decode clients over a ``DecodeEngine``
+    fleet, prompts straddling the 8->16 prompt-bucket boundary, with the
+    KV pool sized so LRU evictions (and their re-prefill recoveries)
+    happen DURING the run.
+
+    Every session's generated token stream is checked against a
+    sequential ``rnn_time_step`` reference computed beforehand, and one
+    session's logits are checked bit-for-bit — so the published
+    tokens/sec is for decoding that provably coalesces, evicts, and
+    recovers without changing a single output (the fixed-extent-cache
+    contract, ops/attention.py)."""
+    from deeplearning4j_tpu.serving.decode import DecodeEngine
+    from deeplearning4j_tpu.zoo import F32, gpt_mini
+
+    net = gpt_mini(vocab_size=vocab, width=width, n_layers=n_layers,
+                   n_heads=n_heads, max_len=max_cache_len,
+                   max_cache_len=max_cache_len, dtype=F32)
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(0, vocab, int(n))]
+               for n in rng.integers(5, 21, sessions)]
+
+    def oh(ids):
+        xx = np.zeros((1, len(ids), vocab), np.float32)
+        xx[0, np.arange(len(ids)), ids] = 1.0
+        return xx
+
+    def ref_generate(ids):
+        net.rnn_clear_previous_state()
+        o = np.asarray(net.rnn_time_step(oh(ids)))[0, -1]
+        seq = []
+        for _ in range(gen_tokens):
+            nxt = int(np.argmax(o))
+            seq.append(nxt)
+            o = np.asarray(net.rnn_time_step(oh([nxt])))[0, 0]
+        return seq
+
+    refs = [ref_generate(ids) for ids in prompts]
+
+    eng = DecodeEngine(net, replicas=replicas, n_pages=n_pages,
+                       page_tokens=page_tokens, max_batch=max_batch,
+                       batch_window_ms=batch_window_ms)
+    t0 = time.perf_counter()
+    eng.warm()
+    warmup_s = time.perf_counter() - t0
+
+    # logit-level exactness spot check (token equality below could in
+    # principle survive a small numeric drift; this cannot)
+    net.rnn_clear_previous_state()
+    ref_l = np.asarray(net.rnn_time_step(oh(prompts[0])))[0, -1]
+    logits_exact = bool(np.array_equal(ref_l, eng.prefill("check",
+                                                          prompts[0])))
+    tok = int(np.argmax(ref_l))
+    ref_l2 = np.asarray(net.rnn_time_step(oh([tok])))[0, 0]
+    logits_exact &= bool(np.array_equal(ref_l2, eng.step("check", tok)))
+    eng.close_session("check")
+    net.rnn_clear_previous_state()
+
+    results: list = [None] * sessions
+    step_times: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    gate = threading.Event()
+
+    def worker(i: int):
+        ts: list[float] = []
+        try:
+            gate.wait()
+            out = eng.generate(f"s{i}", prompts[i], gen_tokens,
+                               step_times=ts)
+            with lock:
+                results[i] = out
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            with lock:
+                step_times.extend(ts)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(sessions)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    gate.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    desc = eng.describe()
+    eng.stop()
+    if errors:
+        return {"config": "transformer", "error": errors[0]}
+
+    matched = sum(1 for i in range(sessions) if results[i] == refs[i])
+    s = sorted(step_times)
+
+    def pct(q):
+        return round(
+            1000.0 * s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
+
+    hits, misses = desc["affinity_hits"], desc["affinity_misses"]
+    return {
+        "config": "transformer",
+        "model": f"gpt_mini vocab{vocab} w{width} L{n_layers} "
+                 f"h{n_heads} f32 (cache {max_cache_len})",
+        "platform": _platform(),
+        "sessions": sessions, "gen_tokens": gen_tokens,
+        "replicas": replicas,
+        "prompt_lens": sorted(len(p) for p in prompts),
+        "warmup_s": round(warmup_s, 3),
+        "wall_s": round(wall, 3),
+        "decode_tokens_per_sec": round(sessions * gen_tokens / wall, 1),
+        "inter_token_p50_ms": pct(0.50),
+        "inter_token_p99_ms": pct(0.99),
+        "decode_bit_identical":
+            1 if (matched == sessions and logits_exact) else 0,
+        "sessions_matched": matched,
+        "logits_exact": logits_exact,
+        "kv_pool_occupancy": round(desc["occupancy"], 4),
+        "kv_pool_pages": desc["n_pages"],
+        "kv_page_tokens": desc["page_tokens"],
+        "kv_evictions": desc["evictions"],
+        "reprefills": desc["reprefills"],
+        "decode_steps": desc["decode_steps"],
+        "affinity_hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else None,
+    }
+
+
 # ------------------------------------------------------------- fleet bench
 def run_load_inproc(server, x: np.ndarray, reference: np.ndarray,
                     clients: int, requests_per_client: int,
@@ -482,6 +618,18 @@ def main():
                          "serving_fleet, gated by check_budgets)")
     ap.add_argument("--mesh", action="store_true",
                     help="only the tensor-parallel bit-identity serve")
+    ap.add_argument("--decode", action="store_true",
+                    help="sessionful KV-cache decode load over the "
+                         "DecodeEngine fleet (config transformer; the "
+                         "TRANSFORMER_r01.json receipt, gated by "
+                         "check_budgets)")
+    ap.add_argument("--sessions", type=int, default=12,
+                    help="concurrent decode sessions (--decode)")
+    ap.add_argument("--gen-tokens", type=int, default=24,
+                    help="greedy tokens generated per session (--decode)")
+    ap.add_argument("--no-train", action="store_true",
+                    help="skip the gpt_mini training-MFU entry in the "
+                         "--decode report")
     ap.add_argument("--replicas", type=int, nargs="+", default=[1, 2, 4],
                     help="fleet sweep replica counts")
     ap.add_argument("--device-sim-ms", type=float, default=20.0,
@@ -497,7 +645,26 @@ def main():
     args = ap.parse_args()
     if args.quick:
         args.concurrency, args.requests = [16], 10
-    if args.fleet or args.mesh:
+    if args.decode:
+        report = bench_decode(sessions=args.sessions,
+                              gen_tokens=args.gen_tokens)
+        if not args.no_train and "error" not in report:
+            # the training side of the workload: gpt_mini fit step with
+            # the XLA-cost-model FLOPs ledger (bench.py `transformer`) —
+            # train_mfu is hoisted flat so the budget gate sees it
+            import importlib.util
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "bench.py")
+            spec = importlib.util.spec_from_file_location("bench", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            train = mod.run_config("transformer")
+            report["train"] = train
+            if train.get("mfu") is not None:
+                report["train_mfu"] = train["mfu"]
+            if train.get("tokens_per_sec") is not None:
+                report["train_tokens_per_sec"] = train["tokens_per_sec"]
+    elif args.fleet or args.mesh:
         # BEFORE any deeplearning4j_tpu/jax import: the fleet story is
         # "8 simulated devices" — force the host platform to expose them
         os.environ.setdefault(

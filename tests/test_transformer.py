@@ -1,0 +1,338 @@
+"""Transformer workload tier tests (ROADMAP item 1's attention workload).
+
+Pins the decode bit-identity contract (ops/attention.py module
+docstring): incremental KV decode against the fixed cache extent ==
+full-sequence causal forward, bit for bit, at several prompt lengths and
+across a prompt-bucket boundary; plus the paged KV-cache DecodeEngine
+(eviction -> re-prefill recovery, session affinity), the KVPagePool
+accounting, gpt_mini under dp x tp with its published rules, and the
+TRANSFORMER receipt's budget gate.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import zoo
+from deeplearning4j_tpu.serving import (CachePoolFullError, DecodeEngine,
+                                        KVPagePool, StreamingKVForward)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+import check_budgets  # noqa: E402  (scripts/check_budgets.py)
+
+V = 23
+
+
+def _net(dtype=zoo.F32, max_len=48, width=32, n_layers=2, n_heads=4,
+         seed=7):
+    return zoo.gpt_mini(vocab_size=V, width=width, n_layers=n_layers,
+                        n_heads=n_heads, max_len=max_len, dtype=dtype,
+                        seed=seed)
+
+
+def _ids(n, seed=0):
+    return [int(i) for i in np.random.default_rng(seed).integers(0, V, n)]
+
+
+def _onehot(ids):
+    return np.eye(V, dtype=np.float32)[np.asarray(ids)]
+
+
+class TestDecodeBitIdentity:
+    """Incremental decode == full causal forward, exactly (the satellite
+    pin: the KV cache is allocated once at the full extent, so prefill
+    and every later step attend the same fixed shape)."""
+
+    # 3/8/9/16/17 straddle the 8 -> 16 prompt-bucket boundary the
+    # serving tier pads to
+    @pytest.mark.parametrize("t", [3, 8, 9, 16, 17])
+    def test_token_by_token_matches_one_shot(self, t):
+        ids = _ids(t, seed=t)
+        net = _net()
+        full = np.asarray(net.rnn_time_step(_onehot(ids)[None]))
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(_onehot([i]))) for i in ids]
+        net.rnn_clear_previous_state()
+        np.testing.assert_array_equal(np.stack(steps, 1), full)
+
+    def test_chunked_prefill_matches_one_shot(self):
+        ids = _ids(20, seed=3)
+        net = _net()
+        full = np.asarray(net.rnn_time_step(_onehot(ids)[None]))
+        net.rnn_clear_previous_state()
+        a = np.asarray(net.rnn_time_step(_onehot(ids[:9])[None]))
+        b = np.asarray(net.rnn_time_step(_onehot(ids[9:])[None]))
+        net.rnn_clear_previous_state()
+        np.testing.assert_array_equal(np.concatenate([a, b], axis=1), full)
+
+    def test_bf16_policy_keeps_bit_identity(self):
+        # the contract holds under the default BF16 compute policy too
+        ids = _ids(12, seed=5)
+        net = _net(dtype=None)
+        full = np.asarray(net.rnn_time_step(_onehot(ids)[None]))
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(_onehot([i]))) for i in ids]
+        net.rnn_clear_previous_state()
+        np.testing.assert_array_equal(np.stack(steps, 1), full)
+
+    def test_ragged_masked_prefill_matches_batch1(self):
+        # the serving prefill: ragged prompts right-padded to one bucket
+        # with a mask must give each row exactly its own batch-1 logits
+        net = _net()
+        fwd = StreamingKVForward(net)
+        lens = [5, 9, 13, 16]   # straddles the 8 -> 16 rung inside one batch
+        bucket = 16
+        xs, ms, refs = [], [], []
+        for i, t in enumerate(lens):
+            ids = _ids(t, seed=10 + i)
+            x = np.zeros((bucket, V), np.float32)
+            x[:t] = _onehot(ids)
+            m = np.zeros(bucket, np.float32)
+            m[:t] = 1.0
+            xs.append(x)
+            ms.append(m)
+            one = fwd([_onehot(ids)[None], np.ones((1, t), np.float32)])
+            refs.append(one[0][0])
+        out = fwd([np.stack(xs), np.stack(ms)])
+        for i in range(len(lens)):
+            np.testing.assert_array_equal(out[0][i], refs[i],
+                                          err_msg=f"row {i} len {lens[i]}")
+
+    def test_streaming_vs_training_forward_tolerance(self):
+        # the OTHER tier of the contract: streaming (exact mulsum) vs the
+        # training forward (einsum GEMMs via the registry) agree only to
+        # f32 reduction-order noise — close, not bit-equal
+        ids = _ids(16, seed=9)
+        net = _net()
+        stream = np.asarray(net.rnn_time_step(_onehot(ids)[None]))
+        net.rnn_clear_previous_state()
+        train = np.asarray(net.output(_onehot(ids)[None]))
+        np.testing.assert_allclose(stream, train, rtol=5e-6, atol=5e-6)
+
+
+class TestKVPagePool:
+    def test_pages_for_ceil(self):
+        p = KVPagePool(n_pages=8, page_tokens=16)
+        assert p.pages_for(1) == 1
+        assert p.pages_for(16) == 1
+        assert p.pages_for(17) == 2
+        assert p.pages_for(0) == 1   # an admitted session holds >= 1 page
+
+    def test_lru_eviction_and_miss_signal(self):
+        p = KVPagePool(n_pages=4, page_tokens=4)
+        p.put("a", 8, "A")           # 2 pages
+        p.put("b", 8, "B")           # 2 pages -> full
+        assert p.get("a") == "A"     # touch: b becomes LRU
+        p.put("c", 4, "C")           # needs 1 -> evicts b
+        assert p.get("b") is None    # the caller's re-prefill signal
+        assert p.get("a") == "A"
+        assert p.get("c") == "C"
+        assert p.evictions == 1 and p.evicted_pages == 2
+        assert p.pages_used == 3
+
+    def test_recharge_grows_in_place(self):
+        p = KVPagePool(n_pages=4, page_tokens=4)
+        p.put("a", 4, "A1")
+        p.put("a", 8, "A2")          # re-charge, not a second entry
+        assert p.pages_used == 2
+        assert p.sessions == ["a"]
+        assert p.get("a") == "A2"
+
+    def test_session_larger_than_pool_raises(self):
+        p = KVPagePool(n_pages=4, page_tokens=4)
+        with pytest.raises(CachePoolFullError):
+            p.put("x", 17, "X")
+        assert p.pages_used == 0
+
+    def test_drop_and_occupancy(self):
+        p = KVPagePool(n_pages=4, page_tokens=4)
+        p.put("a", 8, "A")
+        assert p.occupancy == 0.5
+        assert p.drop("a") is True
+        assert p.drop("a") is False
+        assert p.pages_used == 0 and p.evictions == 0
+        d = p.describe()
+        assert d["pages_used"] == 0 and d["n_pages"] == 4
+
+
+class TestDecodeEngine:
+    def _refs(self, net, prompts, n_tokens):
+        refs = {}
+        for sid, ids in prompts.items():
+            net.rnn_clear_previous_state()
+            logits = np.asarray(net.rnn_time_step(_onehot(ids)[None]))[0, -1]
+            out = []
+            for _ in range(n_tokens):
+                tok = int(np.argmax(logits))
+                out.append(tok)
+                logits = np.asarray(net.rnn_time_step(_onehot([tok])))[0]
+            refs[sid] = out
+        net.rnn_clear_previous_state()
+        return refs
+
+    def test_generate_matches_sequential_reference(self):
+        # prompt lengths straddle the 8 -> 16 prefill rung
+        net = _net()
+        prompts = {f"s{i}": _ids(t, seed=20 + i)
+                   for i, t in enumerate([5, 9, 13, 17])}
+        refs = self._refs(net, prompts, 6)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0)
+        try:
+            for sid, ids in prompts.items():
+                assert eng.generate(sid, ids, 6) == refs[sid], sid
+            assert eng.prefills == 4 and eng.decode_steps == 24
+        finally:
+            eng.stop()
+
+    def test_eviction_recovers_bit_identically(self):
+        # a pool too small for all sessions forces evictions mid-stream;
+        # step() must re-prefill from token history and the streams must
+        # still match the sequential reference exactly
+        net = _net()
+        prompts = {f"e{i}": _ids(t, seed=30 + i)
+                   for i, t in enumerate([6, 9, 12])}
+        refs = self._refs(net, prompts, 3)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           n_pages=4, page_tokens=4)
+        try:
+            # interleave sessions so each step finds its peers evicted
+            streams = {sid: [] for sid in prompts}
+            logits = {sid: eng.prefill(sid, ids)
+                      for sid, ids in prompts.items()}
+            for _ in range(3):
+                for sid in prompts:
+                    tok = int(np.argmax(logits[sid]))
+                    streams[sid].append(tok)
+                    logits[sid] = eng.step(sid, tok)
+            assert streams == refs
+            assert eng.pool.evictions > 0
+            assert eng.reprefills > 0
+        finally:
+            eng.stop()
+
+    def test_session_affinity_and_close(self):
+        net = _net()
+        eng = DecodeEngine(net, replicas=2, batch_window_ms=1.0)
+        try:
+            eng.generate("a", _ids(6, seed=40), 4)
+            eng.generate("b", _ids(7, seed=41), 4)
+            # first submit per session is a miss, every later one a hit
+            assert eng.fleet.affinity_hits >= 8
+            assert eng.fleet.affinity_misses >= 2
+            assert sorted(eng.sessions) == ["a", "b"]
+            assert eng.pool.pages_used > 0
+            assert eng.close_session("a") is True
+            assert eng.close_session("a") is False
+            assert eng.sessions == ["b"]
+            d = eng.describe()
+            assert d["sessions_live"] == 1 and d["decode_steps"] == 8
+        finally:
+            eng.stop()
+
+    def test_prompt_beyond_cache_extent_raises(self):
+        net = _net(max_len=16)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0)
+        try:
+            assert eng.max_prompt == 16
+            with pytest.raises(ValueError):
+                eng.prefill("big", _ids(17, seed=50))
+            # a session AT the extent can prefill but not step past it
+            eng.prefill("edge", _ids(16, seed=51))
+            with pytest.raises(ValueError):
+                eng.step("edge", 1)
+            with pytest.raises(KeyError):
+                eng.step("nobody", 1)
+        finally:
+            eng.stop()
+
+
+class TestGptMiniTensorParallel:
+    def _mesh2d(self):
+        import jax
+        from jax.sharding import Mesh
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        return Mesh(devs, ("data", "model"))
+
+    def test_published_rules_all_match(self):
+        from deeplearning4j_tpu.parallel.tensor import unmatched_rules
+        net = _net()
+        assert unmatched_rules(zoo.gpt_mini_tp_rules(), net.params) == []
+
+    def test_weights_sharded_per_rules(self):
+        mesh = self._mesh2d()
+        net = _net().use_mesh(mesh, model_axis="model",
+                              tp_rules=zoo.gpt_mini_tp_rules())
+        p = net.params
+        assert tuple(p["layer_0"]["Wtok"].sharding.spec) == (None, "model")
+        assert tuple(p["layer_1"]["Wq"].sharding.spec) == (None, "model")
+        assert tuple(p["layer_1"]["W1"].sharding.spec) == (None, "model")
+        assert tuple(p["layer_1"]["Wo"].sharding.spec) == ("model", None)
+        assert tuple(p["layer_1"]["W2"].sharding.spec) == ("model", None)
+        # norms/biases replicate via the default rule
+        assert tuple(p["layer_1"]["ln1_g"].sharding.spec) == ()
+
+    def test_dp_tp_fit_step_matches_single_device(self):
+        import jax
+
+        from deeplearning4j_tpu.datasets import DataSet
+        mesh = self._mesh2d()
+        rng = np.random.default_rng(8)
+        t = 12
+        x = _onehot(rng.integers(0, V, (8, t)))
+        y = _onehot(rng.integers(0, V, (8, t)))
+        ds = DataSet(x, y)
+
+        tp = _net().use_mesh(mesh, model_axis="model",
+                             tp_rules=zoo.gpt_mini_tp_rules())
+        s_tp = float(tp.fit_batch(ds))
+        single = _net()
+        s_single = float(single.fit_batch(ds))
+        assert abs(s_tp - s_single) < 1e-4
+        for ln in single.params:
+            for pn in single.params[ln]:
+                np.testing.assert_allclose(
+                    np.asarray(jax.device_get(tp.params[ln][pn]),
+                               np.float32),
+                    np.asarray(single.params[ln][pn], np.float32),
+                    rtol=2e-4, atol=1e-5, err_msg=f"{ln}.{pn}")
+
+
+class TestTransformerBudgetGate:
+    def _section(self):
+        with open(os.path.join(_REPO, "BUDGETS.json")) as f:
+            return json.load(f)["transformer"]
+
+    def _good(self):
+        return {"config": "transformer", "decode_bit_identical": 1,
+                "decode_tokens_per_sec": 42.0, "inter_token_p50_ms": 9.0,
+                "train_mfu": 0.2}
+
+    def test_passing_receipt_clears_gate(self):
+        assert check_budgets.check_report(self._good(), self._section()) == []
+
+    def test_mfu_bound_skipped_where_peak_unknown(self):
+        # CPU receipts carry no train_mfu (peak FLOP/s unknown); the
+        # bound must skip, not fail
+        rep = self._good()
+        del rep["train_mfu"]
+        assert check_budgets.check_report(rep, self._section()) == []
+
+    def test_broken_receipt_fails_gate(self):
+        rep = self._good()
+        rep["decode_bit_identical"] = 0
+        rep["decode_tokens_per_sec"] = 1.0
+        violations = check_budgets.check_report(rep, self._section())
+        assert len(violations) == 2
+        assert any("decode_bit_identical" in v for v in violations)
+        assert any("decode_tokens_per_sec" in v for v in violations)
+
+    def test_repo_receipt_if_present(self):
+        path = os.path.join(_REPO, "TRANSFORMER_r01.json")
+        if not os.path.exists(path):
+            pytest.skip("no TRANSFORMER_r01.json receipt in the checkout")
+        assert check_budgets.main(["--bench", path]) == 0

@@ -98,6 +98,66 @@ def test_fit_batch_repeated_graph():
     assert net.iteration == 6
 
 
+def test_gpt_mini_builds_and_trains():
+    net = zoo.gpt_mini(vocab_size=16, width=32, n_layers=2, n_heads=4,
+                       max_len=24, dtype=F32)
+    rng = np.random.default_rng(0)
+    x = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 12))]
+    y = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 12))]
+    s0 = float(net.fit_batch(DataSet(x, y)))
+    for _ in range(10):
+        s = float(net.fit_batch(DataSet(x, y)))
+    assert np.isfinite(s) and s < s0
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 12, 16)
+    np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-4)
+
+
+def test_gpt_mini_precision_hygiene():
+    """Default policy is BF16 compute with f32 masters: every param and
+    optimizer-state leaf must stay float32 (PRECISION.md — low-precision
+    leaves must never reach a checkpoint)."""
+    net = zoo.gpt_mini(vocab_size=16, width=32, n_layers=2, n_heads=4,
+                       max_len=24)
+    rng = np.random.default_rng(1)
+    x = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 8))]
+    y = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 8))]
+    net.fit_batch(DataSet(x, y))
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    for leaf in jax.tree_util.tree_leaves(net.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+
+
+def test_gpt_mini_serialization_roundtrip(tmp_path):
+    from deeplearning4j_tpu.utils.serialization import (
+        restore_multi_layer_network, write_model)
+    net = zoo.gpt_mini(vocab_size=16, width=32, n_layers=2, n_heads=4,
+                       max_len=24, dtype=F32)
+    rng = np.random.default_rng(2)
+    x = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 10))]
+    y = np.eye(16, dtype=np.float32)[rng.integers(0, 16, (4, 10))]
+    net.fit_batch(DataSet(x, y))
+    path = tmp_path / "gpt_mini.zip"
+    write_model(net, path)
+
+    net2 = restore_multi_layer_network(path)
+    np.testing.assert_array_equal(
+        np.asarray(net.params["layer_1"]["Wq"]),
+        np.asarray(net2.params["layer_1"]["Wq"]))
+    np.testing.assert_allclose(np.asarray(net2.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
+    # the restored net still honors the streaming decode contract
+    ids = rng.integers(0, 16, 6)
+    xs = np.eye(16, dtype=np.float32)[ids]
+    full = np.asarray(net2.rnn_time_step(xs[None]))
+    net2.rnn_clear_previous_state()
+    steps = [np.asarray(net2.rnn_time_step(xs[i][None])) for i in range(6)]
+    np.testing.assert_array_equal(np.stack(steps, 1), full)
+
+
 def test_vgg16_builds_and_runs_tiny():
     """VGG-16 zoo entry (TrainedModels.java parity): structure + a forward
     pass at a reduced image size (full 224 is bench territory)."""
