@@ -9,6 +9,10 @@ Three layers:
   ``jax_compilation_cache_dir`` through ``ModelServer``/``serve()``/
   ``fit``/``resilient_fit``; hit/miss traffic lands in
   ``dl4j_xla_cache_hits_total`` / ``_misses_total`` and on RunReport.
+  The dir may be a SHARED mount (NFS/GCS-style): ``configure`` stamps
+  it with an atomically-published marker and is concurrent-configure
+  safe across processes, so a whole fleet warm-boots from one host's
+  compiles (SERVING.md "Cross-host federation").
 - :mod:`manifest` + :mod:`precompile` — AOT ``lower().compile()`` of
   the serving bucket ladder and both nets' train steps at BUILD time
   (scripts/precompile.py), persisting executables into the cache dir
@@ -20,9 +24,11 @@ Three layers:
   config via ``tuning_report=``.
 """
 
-from deeplearning4j_tpu.compilecache.cache import (ENV_VAR, cache_dir,
+from deeplearning4j_tpu.compilecache.cache import (ENV_VAR, META_NAME,
+                                                   atomic_publish, cache_dir,
                                                    configure, deactivate,
-                                                   ensure_configured)
+                                                   ensure_configured,
+                                                   shared_meta)
 
-__all__ = ["ENV_VAR", "cache_dir", "configure", "deactivate",
-           "ensure_configured"]
+__all__ = ["ENV_VAR", "META_NAME", "cache_dir", "configure", "deactivate",
+           "ensure_configured", "atomic_publish", "shared_meta"]

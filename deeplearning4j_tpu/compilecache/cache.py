@@ -26,12 +26,32 @@ The cache key is the HLO module + compile options, so it is shared by
 lazy jit, warm-up ladders and AOT ``lower().compile()`` — precompiling
 at build time (compilecache.precompile) and serving later from the
 same dir hit the identical entries.
+
+**Shared-directory backend (cross-host).** The same dir can be a
+mounted NFS/GCS-style path shared by a whole serving fleet: host A's
+warm-up compiles become host B's cache hits, so only the FIRST host of
+a fleet ever pays a fresh compile (measured by
+``scripts/crosshost_serve_bench.py``; SERVING.md "Cross-host
+federation"). What makes the dir safe to share:
+
+- jax's file-system cache already publishes each entry via its own
+  tmp+rename, so a reader never sees a partial executable;
+- :func:`configure` stamps the dir with an atomically-published
+  ``dl4j_cache_meta.json`` marker (:func:`atomic_publish`: unique tmp
+  name per process/thread + ``os.replace``) recording schema and first
+  writer — N processes configuring the same dir concurrently race
+  benignly: every writer replaces a COMPLETE file, the first valid
+  marker is kept, and no ``*.tmp`` turds survive;
+- re-configure is idempotent per resolved dir, cross-process included
+  (pinned by ``tests/test_crosshost_serving.py``).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import threading
+import uuid
 from typing import Optional
 
 #: env var consulted by :func:`ensure_configured` (fit / resilient_fit /
@@ -39,8 +59,83 @@ from typing import Optional
 #: persistent cache without touching call sites
 ENV_VAR = "DL4J_TPU_COMPILE_CACHE"
 
+#: the shared-dir marker :func:`configure` publishes atomically — its
+#: presence (and valid JSON-ness) is the "this dir is a dl4j compile
+#: cache" handshake between hosts sharing the mount
+META_NAME = "dl4j_cache_meta.json"
+META_SCHEMA_VERSION = 1
+
 _lock = threading.Lock()
 _configured: Optional[str] = None
+
+
+def atomic_publish(directory: str, name: str, payload: dict) -> str:
+    """Write ``payload`` as JSON to ``directory/name`` via the
+    tmp+rename protocol shared dirs require: serialize to a tmp file
+    whose name is unique per process/thread (pid + uuid — two hosts on
+    one NFS mount never collide), fsync, then ``os.replace`` onto the
+    final name. A concurrent reader sees either the old complete file
+    or the new complete file, never a torn write; a concurrent writer
+    just wins or loses the whole rename. Returns the final path."""
+    final = os.path.join(directory, name)
+    tmp = os.path.join(
+        directory, f".{name}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    finally:
+        # a crash between write and replace must not leave tmp litter
+        # for the next configure to trip over
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return final
+
+
+def shared_meta(path: Optional[str] = None) -> Optional[dict]:
+    """The shared-dir marker of ``path`` (default: the active cache
+    dir), or None when the dir is unstamped/unreadable."""
+    d = path or _configured
+    if not d:
+        return None
+    try:
+        with open(os.path.join(d, META_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _stamp_shared_dir(resolved: str) -> None:
+    """Publish the ``dl4j_cache_meta.json`` marker if the dir doesn't
+    already carry a valid one. Concurrent-configure safe: losers of the
+    publish race overwrite with an equivalent complete marker; an
+    existing valid marker is left untouched (idempotent re-configure —
+    the first writer's identity stays recorded); a corrupt marker is
+    replaced. Never raises — a read-only shared mount still serves
+    hits, it just stays unstamped."""
+    if shared_meta(resolved) is not None:
+        return
+    try:
+        from deeplearning4j_tpu.observability.distributed import \
+            get_identity
+        created_by = get_identity().tag
+    except Exception:
+        created_by = f"pid-{os.getpid()}"
+    import time
+    try:
+        atomic_publish(resolved, META_NAME, {
+            "schema": META_SCHEMA_VERSION,
+            "created_unix": round(time.time(), 3),
+            "created_by": created_by,
+        })
+    except OSError:
+        pass
 
 
 def cache_dir() -> Optional[str]:
@@ -67,6 +162,7 @@ def configure(path: Optional[str] = None) -> Optional[str]:
         if _configured == resolved:
             return _configured
         os.makedirs(resolved, exist_ok=True)
+        _stamp_shared_dir(resolved)
         import jax
         jax.config.update("jax_compilation_cache_dir", resolved)
         # stock floors (1s compile time, min serialized bytes) exist to

@@ -63,7 +63,7 @@ __all__ = [
     "ProcessIdentity", "get_identity", "set_identity", "reset_identity",
     "bump_incarnation", "new_trace_id", "stamp_run_marker", "TRACE_HEADER",
     "export_snapshot", "MetricsFederation", "SNAPSHOT_SCHEMA_VERSION",
-    "rank_suffix", "push_snapshot",
+    "rank_suffix", "push_snapshot", "HeartbeatPusher",
 ]
 
 #: the header /predict accepts and echoes; serve_bench generates them
@@ -276,6 +276,87 @@ def push_snapshot(url: str, registry=None, health: Optional[dict] = None,
             sleep_fn(min(backoff_max_s,
                          delay * (1.0 + jitter * random.random())))
             delay = min(delay * backoff_factor, backoff_max_s)
+
+
+class HeartbeatPusher:
+    """Background push loop: POST a fresh :func:`export_snapshot` to an
+    aggregator every ``interval_s`` until stopped.
+
+    This is the worker-fleet side of the cross-host serving federation
+    (serving/router.py): each host's ``ModelServer`` runs one of these
+    against the router's ``/api/metrics_push``, so the router's routing
+    and liveness decisions ride live queue-depth/heartbeat gauges. The
+    push retry is ON here (``attempts=3`` by default, jittered
+    exponential backoff — the :func:`push_snapshot` opt-in): a router
+    restart or transient refusal costs a host one delayed heartbeat,
+    not its scoreboard row. The backoff schedule is pinned by
+    ``tests/test_crosshost_serving.py``.
+
+    ``health_fn`` (no-arg -> dict) is re-evaluated per push so the
+    delivered readiness payload is current, not construction-time.
+    """
+
+    def __init__(self, url: str, interval_s: float = 2.0, *,
+                 health_fn=None, registry=None, timeout: float = 5.0,
+                 attempts: int = 3, backoff_initial_s: float = 0.2,
+                 backoff_factor: float = 2.0, backoff_max_s: float = 2.0,
+                 jitter: float = 0.5):
+        self.url = url
+        self.interval_s = float(interval_s)
+        self.health_fn = health_fn
+        self.registry = registry
+        self.timeout = float(timeout)
+        self.attempts = int(attempts)
+        self.backoff_initial_s = float(backoff_initial_s)
+        self.backoff_factor = float(backoff_factor)
+        self.backoff_max_s = float(backoff_max_s)
+        self.jitter = float(jitter)
+        self.pushes_ok = 0
+        self.pushes_failed = 0
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def push_once(self) -> bool:
+        """One push (with the retry policy applied); returns success.
+        Exhausted retries are counted, never raised — a heartbeat loop
+        must outlive its aggregator's bad day."""
+        try:
+            health = self.health_fn() if self.health_fn else None
+            push_snapshot(self.url, self.registry, health,
+                          timeout=self.timeout, attempts=self.attempts,
+                          backoff_initial_s=self.backoff_initial_s,
+                          backoff_factor=self.backoff_factor,
+                          backoff_max_s=self.backoff_max_s,
+                          jitter=self.jitter)
+        except Exception as e:
+            self.pushes_failed += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            return False
+        self.pushes_ok += 1
+        return True
+
+    def start(self) -> "HeartbeatPusher":
+        if self._thread is not None:
+            return self
+        # one synchronous push before the loop: the aggregator knows
+        # this instance the moment start() returns, not one interval in
+        self.push_once()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.push_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="dl4j-heartbeat-push")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.timeout + 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -498,6 +579,13 @@ class MetricsFederation:
                 "pushes": ent["pushes"],
                 "queue_depth": _family_value(
                     snap, "dl4j_serving_queue_depth", agg=sum),
+                # the cross-host routing gauges (serving/router.py):
+                # backlog-derived Retry-After and observed drain rate,
+                # straight off the host's pushed serving families
+                "retry_after_s": _family_value(
+                    snap, "dl4j_serving_retry_after_seconds", agg=min),
+                "drain_rate_rows_per_s": _family_value(
+                    snap, "dl4j_serving_drain_rate_rows_per_s", agg=sum),
                 "steps_total": steps,
                 "last_progress_age_s": (
                     round(max(0.0, now - ent["steps_changed_at"]), 3)

@@ -37,7 +37,11 @@ Per-worker environment (set on top of the parent's):
   ``total_devices`` is set — the launcher keeps the *global* device
   count constant across shrinks (``K = total_devices // size``) so a
   resumed smaller fleet sees the same mesh axis size and restores the
-  old layout via the elastic resharding path bit-identically.
+  old layout via the elastic resharding path bit-identically;
+- ``DL4J_TPU_COMPILE_CACHE`` when ``compile_cache_dir`` is set — the
+  fleet shares one persistent XLA compile cache (the shared-dir
+  backend, compilecache/cache.py), so only the first worker ever pays
+  a fresh compile and relaunched workers boot warm.
 
 The launcher itself never imports jax: worker argv construction is
 delegated to a ``build_argv(size, rank, coordinator)`` callable, so the
@@ -155,7 +159,8 @@ class FleetLauncher:
                  run_id: Optional[str] = None,
                  extra_env: Optional[Dict[str, str]] = None,
                  cwd: Optional[str] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 compile_cache_dir: Optional[str] = None):
         self.build_argv = build_argv
         self.min_size = max(1, int(min_size))
         self.max_launches = int(max_launches)
@@ -169,6 +174,7 @@ class FleetLauncher:
         self.extra_env = dict(extra_env or {})
         self.cwd = cwd
         self.log_dir = log_dir
+        self.compile_cache_dir = compile_cache_dir
 
     # ------------------------------------------------------------- env
     def _worker_env(self, size: int, rank: int, launch_index: int) -> dict:
@@ -179,6 +185,12 @@ class FleetLauncher:
         env["DL4J_TPU_INCARNATION"] = str(launch_index)
         env["JAX_NUM_PROCESSES"] = str(size)
         env["JAX_PROCESS_ID"] = str(rank)
+        if self.compile_cache_dir:
+            # the whole fleet shares ONE persistent compile cache
+            # (compilecache/cache.py shared-dir backend): worker 0's
+            # compiles are every later worker's — and every RELAUNCHED
+            # worker's — cache hits
+            env["DL4J_TPU_COMPILE_CACHE"] = self.compile_cache_dir
         if self.total_devices:
             if self.total_devices % size:
                 raise ValueError(
@@ -312,6 +324,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--min-size", type=int, default=1)
     ap.add_argument("--max-launches", type=int, default=8)
     ap.add_argument("--total-devices", type=int, default=None)
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="shared persistent XLA compile cache dir "
+                         "exported to every worker as "
+                         "DL4J_TPU_COMPILE_CACHE")
     ap.add_argument("--grace", type=float, default=30.0)
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command (after --)")
@@ -329,6 +345,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     result = FleetLauncher(
         build_argv, min_size=args.min_size,
         max_launches=args.max_launches, total_devices=args.total_devices,
+        compile_cache_dir=args.compile_cache_dir,
         straggler_grace_s=args.grace).run(args.size)
     print(f"[launcher] {result.status} after {len(result.launches)} "
           f"launch(es), final size {result.final_size}")

@@ -1,0 +1,610 @@
+"""Cross-host serving federation: a queue-depth front-door router over
+N single-host fleets.
+
+Single-host serving tops out at one process: ``ReplicaSet`` already
+routes tickets across N batcher workers *inside* a host, but every
+request still lands on one admission queue, one device pool, one
+failure domain. This module is the same design played one level up —
+the TF-paper cluster serving model (PAPERS.md): many hosts behind one
+front door, routed by live load, federated through the PR 8 metrics
+plane.
+
+- **Least-loaded routing for stateless /predict.** Each backend host
+  pushes its metrics snapshot (``HeartbeatPusher`` -> the router's
+  ``/api/metrics_push``); the router scores every routable host by its
+  pushed ``dl4j_serving_queue_depth`` plus the router's own in-flight
+  count to that host (the between-pushes signal), and proxies the
+  request to the minimum — round-robin on ties, exactly the
+  ``ReplicaSet._pick`` shape over hosts instead of replicas.
+- **Session-affine routing for /decode.** A decode session's KV cache
+  is warm on ONE host; the router pins ``sid -> host`` and keeps the
+  session's full token history. Every forwarded ``step`` carries that
+  history, so when the pinned host dies (connection error) or goes
+  heartbeat-stale, the router re-pins to a survivor and the survivor's
+  ``DecodeEngine`` re-prefills from the history — the PR 13
+  eviction-recovery contract across processes, bit-identical (the
+  history is appended only after a step's reply lands, so a lost reply
+  replays exactly).
+- **Host eviction + in-flight retry.** A connection-level failure
+  evicts the host (status ``dead``) and retries the request on a
+  survivor — safe for /predict (pure function of the payload) and for
+  /decode (recovery-by-history makes the step idempotent). This is the
+  PR 9 replica-eviction/requeue semantics one level up: a request
+  escapes with an error only when EVERY host is gone.
+- **Global backpressure.** When every routable host answers 503 the
+  router answers 503 with ``Retry-After`` = the MINIMUM of the hosts'
+  derived Retry-After values (header if the host replied, pushed
+  ``dl4j_serving_retry_after_seconds`` gauge otherwise): the client
+  should return when the SOONEST host expects headroom.
+- **Degraded health, federated scoreboard.** ``GET /healthz`` answers
+  ``ok`` / ``degraded`` (both 200) / ``unhealthy`` (503, no hosts
+  left) — the PR 9 fleet semantics; ``GET /api/fleet`` serves the
+  federation scoreboard plus the live routing table, and a router
+  given ``push_url`` pushes its own snapshot (routing table in the
+  health payload) to a dashboard UIServer, which renders it.
+
+The router never imports jax — it is a pure dispatch process, cheap
+enough to front accelerator hosts without stealing their cores.
+Receipts: ``scripts/crosshost_serve_bench.py`` -> CROSSHOST_SERVE_r01,
+gated by BUDGETS.json ``cross_host_serving``. See SERVING.md
+"Cross-host federation".
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability.distributed import (HeartbeatPusher,
+                                                          MetricsFederation,
+                                                          TRACE_HEADER,
+                                                          new_trace_id)
+
+__all__ = ["FrontDoorRouter", "HostHandle", "NoHostsError",
+           "BACKEND_HEADER"]
+
+#: echoed on every proxied reply: which backend host served it
+BACKEND_HEADER = "X-DL4J-Backend"
+
+#: Retry-After floor when no host supplied a derived value (matches the
+#: ServingStats clamp's low end)
+_RETRY_AFTER_FLOOR_S = 0.05
+
+LIVE, DEAD = "live", "dead"
+
+
+class NoHostsError(RuntimeError):
+    """Every backend host is evicted or stale — nothing to route to."""
+
+
+class _HostDown(Exception):
+    """Connection-level failure talking to one host (refused / reset /
+    timeout) — triggers eviction + retry, never escapes the router."""
+
+
+class HostHandle:
+    """One backend host: address, status, a small keep-alive connection
+    pool, and the router-side load/accounting counters."""
+
+    def __init__(self, base_url: str, timeout_s: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        u = urlparse(self.base_url)
+        self.addr = (u.hostname or "127.0.0.1", u.port or 80)
+        self.timeout_s = float(timeout_s)
+        self.status = LIVE
+        self.in_flight = 0
+        self.picks = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._idle: List[http.client.HTTPConnection] = []
+
+    # ------------------------------------------------------- connection pool
+    def acquire(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return http.client.HTTPConnection(*self.addr,
+                                          timeout=self.timeout_s)
+
+    def release(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if self.status == LIVE and len(self._idle) < 32:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def discard(self, conn: http.client.HTTPConnection) -> None:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for c in idle:
+            self.discard(c)
+
+    # ------------------------------------------------------------ accounting
+    def enter(self) -> None:
+        with self._lock:
+            self.in_flight += 1
+            self.picks += 1
+
+    def leave(self) -> None:
+        with self._lock:
+            self.in_flight -= 1
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"url": self.base_url, "status": self.status,
+                    "in_flight": self.in_flight, "picks": self.picks,
+                    "errors": self.errors}
+
+
+class FrontDoorRouter:
+    """The front door: an HTTP server federating N backend
+    ``ModelServer`` hosts.
+
+    ``hosts`` are backend base URLs (``http://127.0.0.1:9500``); more
+    can join live via :meth:`add_host` (the bench grows the fleet
+    mid-run to measure scaling through ONE router). ``stale_after_s``
+    is the heartbeat-age bound past which a host stops receiving new
+    requests (it is not evicted — a paused host resumes when its pushes
+    resume; eviction is for connection-level death).
+    """
+
+    def __init__(self, hosts=(), host: str = "127.0.0.1", port: int = 0,
+                 *, stale_after_s: float = 10.0,
+                 request_timeout_s: float = 120.0,
+                 federation: Optional[MetricsFederation] = None,
+                 push_url: Optional[str] = None,
+                 push_interval_s: float = 2.0):
+        self.host = host
+        self.port = port
+        self.request_timeout_s = float(request_timeout_s)
+        self.federation = federation if federation is not None else \
+            MetricsFederation(stale_after_s=stale_after_s)
+        self._hosts: List[HostHandle] = []
+        self._lock = threading.Lock()
+        self._rr = 0                       # round-robin tiebreak cursor
+        #: sid -> pinned HostHandle (the affinity map, one level up)
+        self._affinity: Dict[str, HostHandle] = {}
+        #: sid -> full token history (prompt + accepted steps) — the
+        #: cross-host recovery source; ints, so it stays tiny
+        self._history: Dict[str, List[int]] = {}
+        self.requests_total = 0
+        self.decode_steps_total = 0
+        self.retried_total = 0            # in-flight retries onto survivors
+        self.evicted_total = 0
+        self.failovers_total = 0          # decode sessions re-pinned
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.shed_total = 0               # global-backpressure 503s
+        self._httpd = None
+        self._thread = None
+        self._pusher: Optional[HeartbeatPusher] = None
+        self._push_url = push_url
+        self._push_interval_s = float(push_interval_s)
+        for u in hosts:
+            self.add_host(u)
+
+    # -------------------------------------------------------------- topology
+    def add_host(self, base_url: str) -> HostHandle:
+        h = HostHandle(base_url, timeout_s=self.request_timeout_s)
+        with self._lock:
+            self._hosts.append(h)
+        return h
+
+    @property
+    def hosts(self) -> List[HostHandle]:
+        with self._lock:
+            return list(self._hosts)
+
+    def _fed_rows(self) -> Dict[str, dict]:
+        """Federation health rows keyed by the pushing host's
+        self-reported ``server_url`` (ModelServer puts it in the health
+        payload) — the join between 'who pushed' and 'where I proxy'."""
+        rows = {}
+        for row in self.federation.health():
+            url = (row.get("health") or {}).get("server_url")
+            if url:
+                rows[url.rstrip("/")] = row
+        return rows
+
+    def _evict(self, h: HostHandle) -> None:
+        with self._lock:
+            if h.status == DEAD:
+                return
+            h.status = DEAD
+            h.errors += 1
+            self.evicted_total += 1
+        h.close()
+
+    # --------------------------------------------------------------- routing
+    def _routable(self, exclude=()) -> List[HostHandle]:
+        """Hosts new work may go to: not evicted, not heartbeat-stale
+        (a host that has never pushed is trusted — the metrics plane is
+        a routing signal, not an admission gate)."""
+        fed = self._fed_rows()
+        out = []
+        for h in self.hosts:
+            if h.status != LIVE or h in exclude:
+                continue
+            row = fed.get(h.base_url)
+            if row is not None and not row["live"]:
+                continue
+            out.append((h, row))
+        return out
+
+    def _pick(self, exclude=()) -> Optional[HostHandle]:
+        """Least-loaded routable host: pushed queue depth + local
+        in-flight, round-robin on ties — ``ReplicaSet._pick`` over
+        hosts."""
+        cands = self._routable(exclude)
+        if not cands:
+            return None
+        scored = []
+        for h, row in cands:
+            depth = (row or {}).get("queue_depth") or 0
+            scored.append((depth + h.in_flight, h))
+        best = min(s for s, _ in scored)
+        ties = [h for s, h in scored if s == best]
+        with self._lock:
+            self._rr += 1
+            return ties[self._rr % len(ties)]
+
+    def _pick_affine(self, sid: str) -> Optional[HostHandle]:
+        """The session's pinned host while it remains routable; a
+        fresh least-loaded pin otherwise (first touch = miss, re-pin
+        after host loss = failover, both counted)."""
+        with self._lock:
+            pinned = self._affinity.get(sid)
+        if pinned is not None:
+            fed_row = self._fed_rows().get(pinned.base_url)
+            stale = fed_row is not None and not fed_row["live"]
+            if pinned.status == LIVE and not stale:
+                with self._lock:
+                    self.affinity_hits += 1
+                return pinned
+        h = self._pick()
+        if h is None:
+            return None
+        with self._lock:
+            if pinned is not None:
+                self.failovers_total += 1
+            self.affinity_misses += 1
+            self._affinity[sid] = h
+        return h
+
+    def _min_retry_after(self, collected: List[float]) -> float:
+        """The aggregated Retry-After for a fleet-wide 503: the soonest
+        any host expects headroom — reply headers first, pushed
+        ``retry_after_s`` gauges as the fallback."""
+        vals = list(collected)
+        for row in self._fed_rows().values():
+            ra = row.get("retry_after_s")
+            if ra is not None:
+                vals.append(float(ra))
+        return min(vals) if vals else _RETRY_AFTER_FLOOR_S
+
+    # ---------------------------------------------------------------- proxy
+    def _proxy(self, h: HostHandle, path: str, body: bytes,
+               trace_id: str):
+        """One request/reply over the host's pooled connection. Raises
+        ``_HostDown`` on any connection-level failure."""
+        conn = h.acquire()
+        try:
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json",
+                          TRACE_HEADER: trace_id})
+            resp = conn.getresponse()
+            data = resp.read()
+            retry_after = resp.getheader("Retry-After")
+            h.release(conn)
+            return resp.status, data, retry_after
+        except (OSError, http.client.HTTPException) as e:
+            h.discard(conn)
+            raise _HostDown(f"{h.base_url}: {type(e).__name__}: {e}")
+
+    def _route(self, path: str, body: bytes, trace_id: str,
+               pick_fn) -> tuple:
+        """Pick -> proxy -> on host death evict + retry on a survivor;
+        on fleet-wide 503, shed with the aggregated Retry-After.
+        Returns (status, payload bytes, headers list)."""
+        tried: List[HostHandle] = []
+        retry_afters: List[float] = []
+        while True:
+            h = pick_fn(tried)
+            if h is None:
+                break
+            h.enter()
+            try:
+                status, data, ra = self._proxy(h, path, body, trace_id)
+            except _HostDown:
+                self._evict(h)
+                tried.append(h)
+                with self._lock:
+                    self.retried_total += 1
+                continue
+            finally:
+                h.leave()
+            if status == 503:
+                # overloaded (or draining) host: try the others before
+                # bouncing the client — that IS the front door's job
+                if ra is not None:
+                    try:
+                        retry_afters.append(float(ra))
+                    except ValueError:
+                        pass
+                tried.append(h)
+                continue
+            return status, data, [(BACKEND_HEADER, h.base_url)], h
+        if tried:
+            with self._lock:
+                self.shed_total += 1
+            ra = self._min_retry_after(retry_afters)
+            return (503,
+                    json.dumps({"error": "all hosts overloaded or "
+                                         "unreachable"}).encode(),
+                    [("Retry-After", f"{ra:g}")], None)
+        raise NoHostsError("no routable backend hosts")
+
+    # ------------------------------------------------------------- endpoints
+    def handle_predict(self, body: bytes, trace_id: str) -> tuple:
+        with self._lock:
+            self.requests_total += 1
+        return self._route("/predict", body, trace_id,
+                           lambda tried: self._pick(exclude=tried))[:3]
+
+    def handle_decode(self, payload: dict, trace_id: str) -> tuple:
+        """Session-affine proxy for the host /decode protocol. The
+        router owns the canonical token history; the host request
+        always carries it, so ANY host can serve the step by
+        re-prefilling (the host's DecodeEngine does exactly that for an
+        unknown sid)."""
+        op = payload.get("op")
+        sid = payload.get("sid")
+        if not sid or op not in ("prefill", "step", "close"):
+            return (400, json.dumps(
+                {"error": "decode payload needs op "
+                          "(prefill|step|close) and sid"}).encode(), [])
+        if op == "prefill":
+            ids = [int(i) for i in payload.get("ids") or ()]
+            if not ids:
+                return (400, json.dumps(
+                    {"error": "prefill needs ids"}).encode(), [])
+            with self._lock:
+                self._history[sid] = list(ids)
+            body = json.dumps({"op": "prefill", "sid": sid,
+                               "ids": ids}).encode()
+            status, data, headers, _ = self._route(
+                "/decode", body, trace_id,
+                lambda tried: (self._pick_affine(sid) if not tried
+                               else self._pick(exclude=tried)))
+            return status, data, headers
+        if op == "close":
+            with self._lock:
+                self._history.pop(sid, None)
+                pinned = self._affinity.pop(sid, None)
+            if pinned is None or pinned.status != LIVE:
+                return 200, json.dumps({"closed": False}).encode(), []
+            try:
+                status, data, ra = self._proxy(
+                    pinned, "/decode",
+                    json.dumps({"op": "close", "sid": sid}).encode(),
+                    trace_id)
+                return status, data, [(BACKEND_HEADER, pinned.base_url)]
+            except _HostDown:
+                self._evict(pinned)
+                return 200, json.dumps({"closed": False}).encode(), []
+        # step
+        with self._lock:
+            history = list(self._history.get(sid) or ())
+            self.decode_steps_total += 1
+        if not history:
+            return (404, json.dumps(
+                {"error": f"unknown decode session '{sid}'"}).encode(), [])
+        token = int(payload["token"])
+
+        def pick(tried):
+            if not tried:
+                return self._pick_affine(sid)
+            # failover mid-step: the pinned host just died under us —
+            # re-pin to a survivor; its engine recovers from `ids`
+            h = self._pick(exclude=tried)
+            if h is not None:
+                with self._lock:
+                    self.failovers_total += 1
+                    self.affinity_misses += 1
+                    self._affinity[sid] = h
+            return h
+
+        body = json.dumps({"op": "step", "sid": sid, "token": token,
+                           "ids": history}).encode()
+        status, data, headers, _ = self._route("/decode", body,
+                                               trace_id, pick)
+        if status == 200:
+            # history grows only on a confirmed reply: a retried lost
+            # reply re-sends the SAME history, so the survivor's
+            # re-prefill replays the session bit-identically
+            with self._lock:
+                hist = self._history.get(sid)
+                if hist is not None:
+                    hist.append(token)
+        return status, data, headers
+
+    # ----------------------------------------------------------------- state
+    def route_table(self) -> List[dict]:
+        """Per-host routing rows: status, load signals, traffic — the
+        /api/fleet 'routing' section and the dashboard scoreboard."""
+        fed = self._fed_rows()
+        rows = []
+        for h in self.hosts:
+            row = fed.get(h.base_url)
+            d = h.describe()
+            d.update({
+                "instance": row["instance"] if row else None,
+                "routable": h.status == LIVE and (row is None
+                                                  or row["live"]),
+                "queue_depth": (row or {}).get("queue_depth"),
+                "retry_after_s": (row or {}).get("retry_after_s"),
+                "drain_rate_rows_per_s":
+                    (row or {}).get("drain_rate_rows_per_s"),
+                "heartbeat_age_s": (row or {}).get("heartbeat_age_s"),
+            })
+            rows.append(d)
+        return rows
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "hosts": len(self._hosts),
+                "requests_total": self.requests_total,
+                "decode_steps_total": self.decode_steps_total,
+                "retried_total": self.retried_total,
+                "evicted_total": self.evicted_total,
+                "failovers_total": self.failovers_total,
+                "affinity_hits": self.affinity_hits,
+                "affinity_misses": self.affinity_misses,
+                "shed_total": self.shed_total,
+                "sessions_live": len(self._history),
+            }
+
+    def healthz(self) -> tuple:
+        rows = self.route_table()
+        n_live = sum(1 for r in rows if r["routable"])
+        if rows and n_live == 0:
+            return 503, {"status": "unhealthy",
+                         "reason": "no routable backend hosts",
+                         "hosts": rows}
+        # some hosts down but traffic still flows: degraded, not down —
+        # the same PR 9 fleet semantics, one level up
+        status = "ok" if n_live == len(rows) else "degraded"
+        return 200, {"status": status, "hosts": rows,
+                     "router": self.describe()}
+
+    def fleet_payload(self) -> dict:
+        payload = self.federation.fleet_payload()
+        payload["routing"] = self.route_table()
+        payload["router"] = self.describe()
+        return payload
+
+    # ---------------------------------------------------------------- server
+    def start(self) -> "FrontDoorRouter":
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, obj, code=200, headers=()):
+                body = obj if isinstance(obj, bytes) \
+                    else json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/healthz"):
+                    code, obj = router.healthz()
+                    self._json(obj, code)
+                elif self.path.startswith("/api/fleet"):
+                    self._json(router.fleet_payload())
+                elif self.path.startswith("/metrics"):
+                    if _obs_metrics.wants_prometheus(
+                            self.headers.get("Accept", ""), self.path):
+                        # merged fleet exposition: every host's pushed
+                        # families instance-labeled + the fleet rollup
+                        text = router.federation.render_prometheus()
+                        body = text.encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            _obs_metrics.PROMETHEUS_CONTENT_TYPE)
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._json(router.describe())
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                trace_id = (self.headers.get(TRACE_HEADER)
+                            or new_trace_id())
+                echo = ((TRACE_HEADER, trace_id),)
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                try:
+                    if self.path.startswith("/predict"):
+                        code, data, hdrs = router.handle_predict(
+                            body, trace_id)
+                    elif self.path.startswith("/decode"):
+                        code, data, hdrs = router.handle_decode(
+                            json.loads(body.decode()), trace_id)
+                    elif self.path.startswith("/api/metrics_push"):
+                        tag = router.federation.ingest(
+                            json.loads(body.decode()))
+                        code, data, hdrs = 200, json.dumps(
+                            {"ok": True, "instance": tag}).encode(), []
+                    else:
+                        code, data, hdrs = 404, json.dumps(
+                            {"error": "not found"}).encode(), []
+                except NoHostsError as e:
+                    code, data, hdrs = 503, json.dumps(
+                        {"error": str(e)}).encode(), []
+                except Exception as e:
+                    code, data, hdrs = 400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(), []
+                self._json(data, code, tuple(hdrs) + echo)
+
+        class _RouterHTTPServer(ThreadingHTTPServer):
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = _RouterHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        if self._push_url:
+            # the router is a fleet member too: its pushed health
+            # payload carries the routing table, so a dashboard
+            # UIServer's scoreboard renders it without new endpoints
+            self._pusher = HeartbeatPusher(
+                self._push_url, self._push_interval_s,
+                health_fn=lambda: {"router_healthy": True,
+                                   "server_url": self.url,
+                                   "routing": self.route_table(),
+                                   "router": self.describe()}).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._pusher is not None:
+            self._pusher.stop()
+            self._pusher = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for h in self.hosts:
+            h.close()

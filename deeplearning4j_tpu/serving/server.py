@@ -27,6 +27,11 @@ arity/row-shape group.
 Endpoints:
 - ``POST /predict``  {"features": [[...]]} or {"inputs": [[[...]], ...]}
   -> {"predictions": ...}
+- ``POST /decode``   (when built with ``decode_engine=``) the sessionful
+  cross-host decode protocol: {"op": "prefill"|"step"|"close", "sid":
+  ..., "ids": [history], "token": t} -> {"logits": [...]} — a ``step``
+  for an unknown sid re-prefills from the carried history, the seam a
+  FrontDoorRouter fails sessions over on (serving/router.py)
 - ``GET /healthz``   liveness + model summary sizes
 - ``GET /metrics``   ServingStats snapshot (JSON); with
   ``Accept: text/plain`` (or ``?format=prometheus``) the unified
@@ -75,7 +80,8 @@ class ModelServer:
                  compute_dtype=None, replicas: int = 1, mesh=None,
                  model_axis: str = "model", data_axis=None, tp_rules=None,
                  compile_cache_dir=None, aot_manifest=None,
-                 tuning_report=None):
+                 tuning_report=None, decode_engine=None,
+                 push_url=None, push_interval_s: float = 2.0):
         from deeplearning4j_tpu.compilecache import cache as _ccache
         # Cold-start engine (SERVING.md "Cold start & AOT"):
         # - compile_cache_dir (or $DL4J_TPU_COMPILE_CACHE) activates the
@@ -162,6 +168,21 @@ class ModelServer:
         # len(shapes_seen) (asserted by the serving concurrency test);
         # shared across replicas: the ladder compiles per forward
         self.shapes_seen = self._fleet.shapes_seen
+        # Cross-host federation (SERVING.md "Cross-host federation"):
+        # - decode_engine: a serving.decode.DecodeEngine this host serves
+        #   sessionful /decode on. The wire protocol carries the full
+        #   token history on every step, so an UNKNOWN sid is recovered
+        #   by re-prefill — bit-identical, which is what lets a
+        #   front-door router fail a session over onto this host after
+        #   its pinned host died.
+        # - push_url: a router/UIServer /api/metrics_push endpoint this
+        #   host heartbeats its metrics snapshot to (HeartbeatPusher,
+        #   retry attempts=3), carrying server_url so the router binds
+        #   the pushed gauges to its proxy target.
+        self.decode_engine = decode_engine
+        self.push_url = push_url
+        self.push_interval_s = float(push_interval_s)
+        self._pusher = None
 
     @property
     def _batcher(self):
@@ -446,8 +467,41 @@ class ModelServer:
                 else:
                     self._json({"error": "not found"}, 404)
 
+            def _decode_op(self, payload):
+                """Host half of the cross-host decode protocol: the
+                request always carries the session's full token history
+                (``ids``), so a ``step`` for a sid this host has never
+                seen — a router failover after another host died — is
+                answered by re-prefilling from that history first. The
+                re-prefill is bit-identical to the steps it replaces
+                (serving/decode.py), so the reply is too."""
+                eng = server.decode_engine
+                op = payload.get("op")
+                sid = payload["sid"]
+                if op == "prefill":
+                    logits = eng.prefill(sid, payload["ids"])
+                    return {"logits": np.asarray(logits).tolist()}
+                if op == "step":
+                    recovered = False
+                    if sid not in eng.sessions:
+                        ids = payload.get("ids") or ()
+                        if not ids:
+                            raise KeyError(
+                                f"unknown decode session '{sid}' and no "
+                                "ids history to recover from")
+                        eng.prefill(sid, ids)
+                        recovered = True
+                    logits = eng.step(sid, int(payload["token"]))
+                    return {"logits": np.asarray(logits).tolist(),
+                            "recovered": recovered}
+                if op == "close":
+                    return {"closed": eng.close_session(sid)}
+                raise ValueError(f"unknown decode op {op!r}")
+
             def do_POST(self):  # noqa: N802
-                if not self.path.startswith("/predict"):
+                is_decode = (self.path.startswith("/decode")
+                             and server.decode_engine is not None)
+                if not self.path.startswith("/predict") and not is_decode:
                     self._json({"error": "not found"}, 404)
                     return
                 # trace-context propagation: accept the client's id (or
@@ -461,6 +515,9 @@ class ModelServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n).decode())
+                    if is_decode:
+                        self._json(self._decode_op(payload), headers=echo)
+                        return
                     if "inputs" in payload:
                         out = server.predict([np.asarray(a) for a in
                                               payload["inputs"]],
@@ -511,7 +568,25 @@ class ModelServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self.push_url:
+            # worker-fleet -> router federation heartbeat: retry is ON
+            # (attempts=3, jittered backoff) so a router restart costs
+            # one delayed push, not this host's scoreboard row
+            self._pusher = _dist.HeartbeatPusher(
+                self.push_url, self.push_interval_s,
+                health_fn=self._push_health).start()
         return self
+
+    def _push_health(self) -> dict:
+        """The health payload each federation push carries: readiness
+        plus ``server_url`` — the key a FrontDoorRouter joins pushed
+        gauges to its proxy target by."""
+        health = {"batcher_healthy": self._fleet.healthy,
+                  "server_url": self.url,
+                  "replicas": self._fleet.describe()}
+        if self.decode_engine is not None:
+            health["decode"] = self.decode_engine.describe()
+        return health
 
     @property
     def url(self) -> str:
@@ -523,6 +598,8 @@ class ModelServer:
         snap = self.stats.snapshot(self.shapes_seen)
         snap["replicas"] = self._fleet.describe()
         snap["requeued_total"] = self._fleet.requeued
+        if self.decode_engine is not None:
+            snap["decode"] = self.decode_engine.describe()
         return snap
 
     def _attach_fleet_collector(self):
@@ -566,10 +643,15 @@ class ModelServer:
         """Stop accepting, then drain: every accepted ticket completes
         before the device thread exits. Closes the serving goodput
         ledger — ``self.run_report`` holds the RunReport afterwards."""
+        if self._pusher is not None:
+            self._pusher.stop()
+            self._pusher = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self.decode_engine is not None:
+            self.decode_engine.stop()
         self._fleet.stop()
         self.stats.detach_from_registry()
         if self._fleet_collector is not None:
@@ -596,7 +678,8 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
           compute_dtype=None, replicas: int = 1, mesh=None,
           model_axis: str = "model", data_axis=None,
           tp_rules=None, compile_cache_dir=None, aot_manifest=None,
-          tuning_report=None) -> ModelServer:
+          tuning_report=None, decode_engine=None, push_url=None,
+          push_interval_s: float = 2.0) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
@@ -607,4 +690,6 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
                        data_axis=data_axis, tp_rules=tp_rules,
                        compile_cache_dir=compile_cache_dir,
                        aot_manifest=aot_manifest,
-                       tuning_report=tuning_report).start()
+                       tuning_report=tuning_report,
+                       decode_engine=decode_engine, push_url=push_url,
+                       push_interval_s=push_interval_s).start()

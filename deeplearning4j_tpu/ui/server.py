@@ -261,7 +261,27 @@ async function refreshFleet(){
       `<td>${r.last_progress_age_s ?? "—"}</td>`+
       `<td>${r.pushes}</td></tr>`;
   });
-  document.getElementById("fleettable").innerHTML = html + "</table>";
+  html += "</table>";
+  // cross-host routing table: a FrontDoorRouter pushing here carries
+  // its per-host routing rows in the health payload (serving/router.py)
+  const routers = rows.filter(
+    r => r.health && Array.isArray(r.health.routing));
+  routers.forEach(R=>{
+    html += `<h4 style="margin:8px 0 4px">Routing table `+
+      `<span class="label">(router ${esc(R.instance)})</span></h4>`+
+      "<table><tr><th>host</th><th>routable</th><th>queue</th>"+
+      "<th>in flight</th><th>picks</th><th>retry-after s</th>"+
+      "<th>heartbeat age s</th></tr>";
+    R.health.routing.forEach(h=>{
+      html += `<tr><td>${esc(h.instance || h.url)}</td>`+
+        `<td>${dot(h.routable)}</td><td>${h.queue_depth ?? "—"}</td>`+
+        `<td>${h.in_flight}</td><td>${h.picks}</td>`+
+        `<td>${h.retry_after_s ?? "—"}</td>`+
+        `<td>${h.heartbeat_age_s ?? "—"}</td></tr>`;
+    });
+    html += "</table>";
+  });
+  document.getElementById("fleettable").innerHTML = html;
 }
 const TRACE_PALETTE=["#1f77b4","#ff7f0e","#2ca02c","#d93025","#9334e6",
   "#8c564b","#e377c2","#7f7f7f","#bcbd22","#12858d"];
