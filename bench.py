@@ -332,6 +332,79 @@ def bench_identity_overhead(batch: int = 1024, n_batches: int = 32,
     }
 
 
+def bench_lockcheck_overhead(batch: int = 1024, n_batches: int = 32,
+                             epochs: int = 4, rounds: int = 3) -> dict:
+    """Lock-order-detector overhead guard: full ``net.fit`` steps/sec
+    with raw locks vs analysis/lockorder-instrumented locks (every
+    ``threading.Lock``/``RLock`` wrapped, acquisition edges recorded,
+    hold spans timed — the regime the whole pytest suite runs under by
+    default, see ANALYSIS.md). The acceptance bar is < 3%: training's
+    hot path is jitted compute, so the wrapper cost must stay in the
+    host-dispatch noise.
+
+    Instrumentation attaches at lock *allocation*, so each arm's
+    net+iterator is built once under that arm's factory, then the two
+    arms are timed back-to-back in paired rounds and the MEDIAN per-round
+    overhead reported — a sequential A-then-B layout (like the other
+    overhead entries) confounds the delta with process-lifetime drift
+    (allocator/cache aging), which on this host-heavy loop dwarfs the
+    real wrapper cost."""
+    from deeplearning4j_tpu import zoo
+    from deeplearning4j_tpu.analysis import lockorder
+    from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.observability.trace import Tracer, set_tracer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch * n_batches, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch * n_batches)]
+    steps = epochs * n_batches
+
+    def build():
+        it = ArrayDataSetIterator(x, y, batch_size=batch, shuffle=True,
+                                  seed=0)
+        net = zoo.mnist_mlp()
+        net.fit(it, epochs=1)             # warm-up: compile + stragglers
+        float(net.score_value)
+        return net, it
+
+    def fit_time(net, it):
+        t0 = time.perf_counter()
+        net.fit(it, epochs=epochs)
+        float(net.score_value)            # execution barrier
+        return (time.perf_counter() - t0) / steps
+
+    was_installed = lockorder.installed()
+    prev_tracer = set_tracer(Tracer(enabled=True))
+    try:
+        lockorder.uninstall()
+        net_off, it_off = build()         # raw locks
+        lockorder.install()
+        net_on, it_on = build()           # instrumented locks
+        lockorder.uninstall()             # arms differ only by their locks
+        overheads, offs, ons = [], [], []
+        for _ in range(rounds):
+            off = fit_time(net_off, it_off)
+            on = fit_time(net_on, it_on)
+            offs.append(off)
+            ons.append(on)
+            overheads.append((on - off) / off * 100.0)
+    finally:
+        if was_installed:
+            lockorder.install()
+        set_tracer(prev_tracer)
+    overhead_pct = sorted(overheads)[len(overheads) // 2]
+    return {
+        "batch": batch,
+        "steps_timed": steps,
+        "rounds": rounds,
+        "steps_per_sec_lockcheck_off": round(1.0 / min(offs), 1),
+        "steps_per_sec_lockcheck_on": round(1.0 / min(ons), 1),
+        "overhead_pct_rounds": [round(p, 3) for p in overheads],
+        "overhead_pct": round(overhead_pct, 3),
+        "overhead_ok": overhead_pct < 3.0,
+    }
+
+
 def bench_input_pipeline(batch: int = 1024, n_batches: int = 32,
                          epochs: int = 4) -> dict:
     """Input-pipeline round: full ``net.fit`` steps/sec and records/sec
@@ -415,6 +488,8 @@ def run_config(name: str) -> dict:
         return bench_goodput_overhead()
     if name == "identity_overhead":
         return bench_identity_overhead()
+    if name == "lockcheck_overhead":
+        return bench_lockcheck_overhead()
     if name == "input_pipeline":
         return bench_input_pipeline()
     if name == "mnist_mlp":
@@ -543,8 +618,8 @@ def _timed(fn) -> float:
 
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
             "transformer", "serving", "host_loop", "trace_overhead",
-            "goodput_overhead", "identity_overhead", "input_pipeline",
-            "mixed_precision")
+            "goodput_overhead", "identity_overhead", "lockcheck_overhead",
+            "input_pipeline", "mixed_precision")
 
 
 def main():

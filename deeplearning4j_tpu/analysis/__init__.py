@@ -1,0 +1,88 @@
+"""Correctness-analysis subsystem: the checks that enforce the stack's
+fragile contracts *before* a chaos demo trips over them.
+
+The runtime spans 20+ threaded modules (batchers, replica fleets, the
+front-door router, heartbeat pushers, checkpoint writers, prefetchers)
+and sells three contracts — bit-identity, zero-fresh-compiles warm
+boots, attributed≈wall goodput — that receipts only verify after the
+fact. This package verifies them by analysis (ANALYSIS.md):
+
+- :mod:`~deeplearning4j_tpu.analysis.concurrency` — an AST pass over
+  the source tree: unguarded ``acquire()``, untimed blocking calls
+  (worse while a lock is held), non-daemon threads, and writes to
+  ``@guarded_by``-registered attributes outside their lock.
+- :mod:`~deeplearning4j_tpu.analysis.jaxpr_lint` — traces the jitted
+  fit steps and serving forwards of the real models and walks the
+  closed jaxprs for dtype-promotion hazards, retrace bombs, donation
+  misses, and primitives outside the determinism allowlist.
+- :mod:`~deeplearning4j_tpu.analysis.lockorder` — an opt-in
+  instrumented lock wrapper (``DL4J_TPU_LOCK_CHECK=1``, default-on
+  under pytest) recording the cross-thread acquisition-order graph;
+  cycles are would-be deadlocks, long holds land in the span tracer.
+
+Everything reports :class:`Finding`s; ``scripts/static_check.py`` gates
+them against the committed ``ANALYSIS_BASELINE.json`` the same way
+``check_budgets.py`` gates efficiency receipts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from deeplearning4j_tpu.analysis.guards import guarded_by
+
+__all__ = ["Finding", "guarded_by", "CODES"]
+
+#: finding code -> one-line meaning (the full table lives in ANALYSIS.md)
+CODES = {
+    "DL4J-C001": "lock acquire() without a guaranteed release "
+                 "(use `with` or try/finally)",
+    "DL4J-C002": "untimed blocking call while a lock is held",
+    "DL4J-C003": "untimed blocking call (no timeout/deadline)",
+    "DL4J-C004": "non-daemon thread with no join-on-shutdown",
+    "DL4J-C005": "write to a @guarded_by attribute outside its lock",
+    "DL4J-J000": "analysis target failed to trace",
+    "DL4J-J001": "f32 matmul/conv under a half-precision compute policy",
+    "DL4J-J002": "x64 weak-type promotion (float64 value in the jaxpr)",
+    "DL4J-J003": "Python-scalar retrace bomb (jit cache grows per call)",
+    "DL4J-J004": "donation miss: fit step re-allocates params/opt_state",
+    "DL4J-J005": "primitive outside the determinism allowlist",
+    "DL4J-L001": "lock acquisition-order cycle (would-be deadlock)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analysis finding. ``fingerprint()`` deliberately excludes the
+    line number so the committed baseline survives unrelated edits that
+    shift code up or down a file."""
+
+    code: str      #: DL4J-Cxxx / DL4J-Jxxx / DL4J-L001
+    path: str      #: repo-relative source path, or the jaxpr target name
+    line: int      #: 1-based line (0 when not tied to a source line)
+    symbol: str    #: enclosing Class.method / function / target symbol
+    message: str   #: human-readable detail (stable: no line numbers)
+
+    def fingerprint(self) -> str:
+        return f"{self.code}|{self.path}|{self.symbol}|{self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Finding":
+        return Finding(code=d["code"], path=d["path"],
+                       line=int(d.get("line", 0)),
+                       symbol=d.get("symbol", ""),
+                       message=d.get("message", ""))
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{self.code} {loc} [{self.symbol}] {self.message}"
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    """Stable report order: by code, then path, then line."""
+    return sorted(findings, key=lambda f: (f.code, f.path, f.line,
+                                           f.symbol, f.message))

@@ -9,6 +9,7 @@ resume needs — bounded by window/buffer sizes, never the dataset.
 
 from __future__ import annotations
 
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
@@ -64,7 +65,10 @@ class MapStage(Stage):
                 if not pending:
                     break
                 raw, fut = pending.pop(0)
-                out = fut.result()
+                # a wedged map fn (hung I/O in user code) must fail the
+                # pipeline, not hang the consumer forever
+                out = fut.result(timeout=float(os.environ.get(
+                    "DL4J_TPU_PIPE_MAP_TIMEOUT_S", "600")))
                 self._inflight.remove(raw)
                 self.records_out += 1
                 yield out

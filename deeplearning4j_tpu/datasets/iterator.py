@@ -157,7 +157,19 @@ class AsyncDataSetIterator(DataSetIterator):
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    # Timed get: a producer that dies without delivering
+                    # its sentinel (killed interpreter thread, bug) must
+                    # surface as an error, not hang the fit loop forever.
+                    item = q.get(timeout=1.0)
+                except queue.Empty:
+                    if not t.is_alive() and q.empty():
+                        if error:
+                            raise error[0]
+                        raise RuntimeError(
+                            "async prefetch producer died without "
+                            "delivering its end-of-data sentinel")
+                    continue
                 if item is self._SENTINEL:
                     if error:
                         raise error[0]

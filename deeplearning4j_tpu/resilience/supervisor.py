@@ -469,8 +469,24 @@ class TrainingSupervisor:
         t, pending = self._ckpt_thread, self._ckpt_pending
         if t is None:
             return
+        timeout_s = float(os.environ.get(
+            "DL4J_TPU_CKPT_JOIN_TIMEOUT_S", "600"))
         with _get_tracer().span("checkpoint_barrier"):
-            t.join()
+            t.join(timeout=timeout_s)
+        if t.is_alive():
+            # the writer wedged (dead filesystem, hung flush): a barrier
+            # that never returns would freeze training; fail the drain
+            # instead and leave the daemon thread to the interpreter
+            err = TimeoutError(
+                f"checkpoint writer did not finish within {timeout_s:g}s "
+                "(DL4J_TPU_CKPT_JOIN_TIMEOUT_S)")
+            self._ckpt_thread = None
+            self._ckpt_pending = None
+            if raise_errors:
+                raise err
+            logger.error("async checkpoint write for %s failed: %r",
+                         pending["path"], err)
+            return
         self._ckpt_thread = None
         self._ckpt_pending = None
         err = pending["error"]

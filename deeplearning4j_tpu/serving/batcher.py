@@ -50,6 +50,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from deeplearning4j_tpu.analysis.guards import guarded_by
 from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 
@@ -116,6 +117,7 @@ def _trace_ids(batch) -> list:
     return out
 
 
+@guarded_by("_cond", "_pending", "_stopping", "_crashed", "_thread")
 class MicroBatcher:
     """Bounded ticket queue + device thread.
 
@@ -219,9 +221,11 @@ class MicroBatcher:
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=60)
-            self._thread = None
+            t = self._thread
+        if t is not None:
+            t.join(timeout=60)
+            with self._cond:
+                self._thread = None
 
     # --------------------------------------------------------------- enqueue
     def submit(self, feats: list, trace_id: str = None) -> Future:

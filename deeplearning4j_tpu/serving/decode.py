@@ -46,12 +46,14 @@ from __future__ import annotations
 
 import threading
 import time
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 from jax import numpy as jnp
 
+from deeplearning4j_tpu.analysis.guards import guarded_by
 from deeplearning4j_tpu.serving.batcher import next_bucket
 from deeplearning4j_tpu.serving.fleet import ReplicaSet
 from deeplearning4j_tpu.serving.kvcache import KVPagePool
@@ -185,6 +187,7 @@ class DecodeSession:
         return len(self.ids)
 
 
+@guarded_by("_lock", "_sessions", "prefills", "decode_steps", "reprefills")
 class DecodeEngine:
     """Sessionful autoregressive decode over a ``ReplicaSet``.
 
@@ -201,7 +204,8 @@ class DecodeEngine:
                  n_pages: int = 256, page_tokens: int = 16,
                  max_batch: int = 64, batch_window_ms: float = 2.0,
                  max_queue: int = 1024, min_batch: int = 2,
-                 min_prompt_bucket: int = 8, stats=None):
+                 min_prompt_bucket: int = 8, stats=None,
+                 request_timeout_s: float = 300.0):
         self.forward = StreamingKVForward(net)
         self.fleet = ReplicaSet(self.forward, replicas, max_batch=max_batch,
                                 batch_window_ms=batch_window_ms,
@@ -213,6 +217,9 @@ class DecodeEngine:
         self.max_prompt = self._max_prompt(net)
         self._sessions: Dict[str, DecodeSession] = {}
         self._lock = threading.Lock()
+        # same-named knob as ModelServer: a dead fleet must fail a decode
+        # session with a deadline error, never hang it forever
+        self.request_timeout_s = float(request_timeout_s)
         self.prefills = 0
         self.decode_steps = 0
         self.reprefills = 0   # evicted sessions re-admitted from history
@@ -250,6 +257,16 @@ class DecodeEngine:
             compiled += self.fleet.warm([(t, v), (t,)])
         return compiled
 
+    def _await(self, fut, sid: str, what: str):
+        try:
+            return fut.result(timeout=self.request_timeout_s)
+        except _FutureTimeout:
+            from deeplearning4j_tpu.serving.server import \
+                DeadlineExceededError
+            raise DeadlineExceededError(
+                f"decode {what} for session '{sid}' exceeded "
+                f"request_timeout_s={self.request_timeout_s:g}s") from None
+
     # ------------------------------------------------------------- lifecycle
     def _run_prefill(self, sid: str, ids: List[int]) -> np.ndarray:
         t = len(ids)
@@ -262,7 +279,8 @@ class DecodeEngine:
         x = self._one_hot(ids, bt)
         mask = np.zeros((1, bt), np.float32)
         mask[0, :t] = 1.0
-        res = self.fleet.submit([x, mask], session=sid).result()
+        res = self._await(self.fleet.submit([x, mask], session=sid),
+                          sid, "prefill")
         logits, leaves = res[0], list(res[1:])
         self.pool.put(sid, t, leaves)
         return logits[0], leaves
@@ -295,7 +313,8 @@ class DecodeEngine:
                 self.reprefills += 1
             leaves = self._run_prefill(sid, sess.ids)[1]
         x = self._one_hot([token], 1)
-        res = self.fleet.submit([x] + list(leaves), session=sid).result()
+        res = self._await(self.fleet.submit([x] + list(leaves),
+                                            session=sid), sid, "step")
         logits, new_leaves = res[0], res[1:]
         sess.ids.append(int(token))
         sess.last_step = time.time()

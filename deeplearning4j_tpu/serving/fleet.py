@@ -54,6 +54,7 @@ import time
 from concurrent.futures import Future
 from typing import List, Optional
 
+from deeplearning4j_tpu.analysis.guards import guarded_by
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError)
 
@@ -84,6 +85,8 @@ class Replica:
                 "queue_depth": self.depth}
 
 
+@guarded_by("_lock", "_rr", "requeued", "_affinity", "affinity_hits",
+            "affinity_misses", "replicas")
 class ReplicaSet:
     """N replicas of one forward behind global admission + least-depth
     routing. With ``n=1`` this degenerates to exactly the single-batcher
@@ -218,8 +221,11 @@ class ReplicaSet:
         old = r.batcher
         if old.healthy:
             old.stop()
-        r.batcher = self._make_batcher(old._forward).start()
+        fresh = self._make_batcher(old._forward).start()
         with self._lock:
+            # publish batcher + status together: a concurrent _pick must
+            # never route to a LIVE replica still holding the dead batcher
+            r.batcher = fresh
             r.status = LIVE
             r.evicted_at = None
         if self.stats is not None:
@@ -344,7 +350,7 @@ class ReplicaSet:
                  outer: Future, session=None):
         exc = inner.exception()
         if exc is None:
-            outer.set_result(inner.result())
+            outer.set_result(inner.result())  # analysis: ok(C003) — done-callback: future already resolved
         elif isinstance(exc, BatcherDeadError):
             # the replica died with this ticket in flight; its future
             # was failed by _die BEFORE any result delivery, so a

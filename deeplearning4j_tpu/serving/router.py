@@ -60,6 +60,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse
 
+from deeplearning4j_tpu.analysis.guards import guarded_by
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
 from deeplearning4j_tpu.observability.distributed import (HeartbeatPusher,
                                                           MetricsFederation,
@@ -88,6 +89,7 @@ class _HostDown(Exception):
     timeout) — triggers eviction + retry, never escapes the router."""
 
 
+@guarded_by("_lock", "_idle", "in_flight", "picks", "status", "errors")
 class HostHandle:
     """One backend host: address, status, a small keep-alive connection
     pool, and the router-side load/accounting counters."""
@@ -148,6 +150,10 @@ class HostHandle:
                     "errors": self.errors}
 
 
+@guarded_by("_lock", "_hosts", "_rr", "_affinity", "_history",
+            "requests_total", "decode_steps_total", "retried_total",
+            "evicted_total", "failovers_total", "affinity_hits",
+            "affinity_misses", "shed_total")
 class FrontDoorRouter:
     """The front door: an HTTP server federating N backend
     ``ModelServer`` hosts.
@@ -299,7 +305,7 @@ class FrontDoorRouter:
                trace_id: str):
         """One request/reply over the host's pooled connection. Raises
         ``_HostDown`` on any connection-level failure."""
-        conn = h.acquire()
+        conn = h.acquire()  # analysis: ok(C001) — pooled connection, not a lock; released/discarded below
         try:
             conn.request("POST", path, body,
                          {"Content-Type": "application/json",

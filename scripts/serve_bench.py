@@ -165,14 +165,14 @@ def run_load(port: int, x: np.ndarray, reference: np.ndarray,
                 trace_ids["sent"] += my_sent
                 trace_ids["echoed"] += my_echoed
 
-    threads = [threading.Thread(target=client, args=(t,))
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
                for t in range(concurrency)]
     for t in threads:
         t.start()
     t0 = time.perf_counter()
     start_gate.set()
     for t in threads:
-        t.join()
+        t.join(timeout=600.0)
     wall = time.perf_counter() - t0
     if errors:
         return {"error": errors[0], "concurrency": concurrency}
@@ -400,14 +400,14 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
             with lock:
                 step_times.extend(ts)
 
-    threads = [threading.Thread(target=worker, args=(i,))
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(sessions)]
     for t in threads:
         t.start()
     t0 = time.perf_counter()
     gate.set()
     for t in threads:
-        t.join()
+        t.join(timeout=600.0)
     wall = time.perf_counter() - t0
     desc = eng.describe()
     eng.stop()
@@ -486,14 +486,14 @@ def run_load_inproc(server, x: np.ndarray, reference: np.ndarray,
             with lock:
                 lats.extend(my_lats)
 
-    threads = [threading.Thread(target=client, args=(t,))
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
                for t in range(clients)]
     for t in threads:
         t.start()
     t0 = time.perf_counter()
     start_gate.set()
     for t in threads:
-        t.join()
+        t.join(timeout=600.0)
     wall = time.perf_counter() - t0
     if errors:
         return {"error": errors[0], "clients": clients}

@@ -1,0 +1,163 @@
+"""CI correctness-analysis gate: run the analysis/ passes and diff the
+findings against the committed ANALYSIS_BASELINE.json.
+
+Two passes run (ANALYSIS.md has the full finding-code table):
+
+- the **concurrency lint** (analysis/concurrency.py): a millisecond AST
+  sweep over deeplearning4j_tpu/, scripts/ and bench.py;
+- the **jaxpr hazard lint** (analysis/jaxpr_lint.py): traces the jitted
+  fit steps and serving forwards of the real models (host-only —
+  ``make_jaxpr``/``lower``, no compile, no device execution) and walks
+  the IR for dtype leaks, retrace bombs, donation misses and
+  off-allowlist primitives.
+
+The gate is a ratchet, same spirit as check_budgets.py:
+
+- a finding NOT in the baseline (or exceeding its baselined count)
+  **fails** — new hazards don't land;
+- a baselined finding that no longer occurs also **fails** ("stale
+  baseline") until the baseline is shrunk with ``--update-baseline`` —
+  fixed hazards can't silently come back.
+
+Baseline entries key on ``code|path|symbol|message`` (no line numbers),
+so unrelated edits that shift code around don't churn the file. The
+shipped baseline is empty: every initial finding was burned down in the
+PR that introduced this gate.
+
+Usage:
+    python scripts/static_check.py                  # the CI gate
+    python scripts/static_check.py --json out.json  # findings as JSON
+    python scripts/static_check.py --update-baseline
+    python scripts/static_check.py --skip-jaxpr     # AST passes only
+
+Exit status 0 = findings match the baseline, 1 = new or stale findings
+(each printed on its own line), 2 = usage / unreadable baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(_REPO, "ANALYSIS_BASELINE.json")
+
+sys.path.insert(0, _REPO)
+
+
+def collect_findings(skip_jaxpr: bool = False):
+    from deeplearning4j_tpu.analysis import concurrency, sort_findings
+
+    findings = concurrency.lint_tree(_REPO)
+    if not skip_jaxpr:
+        import jax
+        # match the pytest environment (tests/conftest.py) so both entry
+        # points trace identical programs and agree on the baseline
+        jax.config.update("jax_enable_x64", True)
+        from deeplearning4j_tpu.analysis import jaxpr_lint
+        findings.extend(jaxpr_lint.lint_all())
+    return sort_findings(findings)
+
+
+def _counts(findings) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        out[fp] = out.get(fp, 0) + 1
+    return out
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {k: int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, counts: Dict[str, int]) -> None:
+    data = {
+        "_comment": "Committed findings the static_check gate tolerates "
+                    "(fingerprint -> count). New findings fail; fixed "
+                    "findings must be removed here (--update-baseline) "
+                    "so they cannot return. See ANALYSIS.md.",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+def gate(findings, baseline: Dict[str, int]) -> List[str]:
+    """-> violation lines (empty == gate passes)."""
+    found = _counts(findings)
+    by_fp = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint(), f)
+    problems = []
+    for fp, n in sorted(found.items()):
+        base = baseline.get(fp, 0)
+        if n > base:
+            problems.append(f"NEW ({n} > baseline {base}): {by_fp[fp]}")
+    for fp, base in sorted(baseline.items()):
+        n = found.get(fp, 0)
+        if n < base:
+            problems.append(
+                f"STALE baseline entry ({n} < baseline {base}) — fixed? "
+                f"shrink it with --update-baseline: {fp}")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default ANALYSIS_BASELINE.json)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the findings as JSON to PATH "
+                         "('-' for stdout)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings")
+    ap.add_argument("--skip-jaxpr", action="store_true",
+                    help="run only the AST passes (no model tracing)")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"unreadable baseline {args.baseline}: {e}", file=sys.stderr)
+        return 2
+
+    findings = collect_findings(skip_jaxpr=args.skip_jaxpr)
+
+    if args.json:
+        payload = json.dumps([f.to_dict() for f in findings], indent=2)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(payload + "\n")
+
+    if args.update_baseline:
+        write_baseline(args.baseline, _counts(findings))
+        print(f"baseline updated: {len(findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    problems = gate(findings, baseline)
+    if problems:
+        for line in problems:
+            print(line)
+        print(f"static_check: {len(problems)} problem(s) "
+              f"({len(findings)} finding(s) vs baseline "
+              f"{os.path.basename(args.baseline)})")
+        return 1
+    print(f"static_check: OK ({len(findings)} finding(s), all baselined; "
+          f"baseline entries: {len(baseline)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,18 @@ if not _TPU_MODE:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
+# Lock-order detection is on by default under pytest (ANALYSIS.md):
+# every threading.Lock/RLock the suite allocates is instrumented, the
+# cross-thread acquisition-order graph accumulates over the whole run,
+# and the session fails if it ends with a cycle (a would-be deadlock
+# some interleaving will eventually hit). DL4J_TPU_LOCK_CHECK=0 opts
+# out. Installed at conftest import time — before any module under test
+# allocates a lock.
+os.environ.setdefault("DL4J_TPU_LOCK_CHECK", "1")
+from deeplearning4j_tpu.analysis import lockorder as _lockorder  # noqa: E402
+
+_lockorder.maybe_install()
+
 # Modules meaningful against the real accelerator (no x64 dependence).
 # DL4J_TPU_TESTS=1 runs ONLY these — the rest of the suite assumes the
 # x64 CPU configuration (f64 gradient checks, tight f64 tolerances) and
@@ -51,3 +63,23 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) not in _TPU_MODULES:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The lock-order gate: a cycle accumulated anywhere in the run is a
+    would-be deadlock — report it and fail the session even when every
+    individual test passed. (Tests that build cycles on purpose use
+    private LockOrderGraphs via lockorder.instrument(graph=...), which
+    never touch the global graph checked here.)"""
+    if not _lockorder.installed():
+        return
+    findings = _lockorder.get_graph().findings()
+    if not findings:
+        return
+    print("\n" + "=" * 24, "lock-order cycles (DL4J-L001)", "=" * 24)
+    for f in findings:
+        print(f)
+    print("cross-thread lock acquisition-order cycle(s) detected — "
+          "see ANALYSIS.md")
+    import pytest
+    session.exitstatus = pytest.ExitCode.TESTS_FAILED

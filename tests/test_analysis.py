@@ -1,0 +1,468 @@
+"""Tests for the correctness-analysis subsystem (ANALYSIS.md): the
+concurrency AST lint, the jaxpr hazard lint, the runtime lock-order
+detector, and the scripts/static_check.py baseline gate.
+
+Every hazard class the passes claim to catch has a positive fixture
+here, plus clean negatives — a lint that never fires and a lint that
+always fires are equally useless.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    Finding, concurrency, guarded_by, lockorder, sort_findings)
+from deeplearning4j_tpu.analysis import jaxpr_lint
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import static_check  # noqa: E402  (scripts/static_check.py)
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# --------------------------------------------------------------------------
+# concurrency lint: one positive fixture per hazard class
+# --------------------------------------------------------------------------
+
+def test_c001_acquire_without_guaranteed_release():
+    src = """
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self):
+        self._lock.acquire()
+        do_work()
+        self._lock.release()
+
+    def good(self):
+        self._lock.acquire()
+        try:
+            do_work()
+        finally:
+            self._lock.release()
+
+    def best(self):
+        with self._lock:
+            do_work()
+"""
+    findings = concurrency.lint_source(src, "fix.py")
+    assert _codes(findings) == ["DL4J-C001"]
+    assert findings[0].symbol == "W.bad"
+
+
+def test_c002_untimed_http_call_while_lock_held():
+    src = """
+import threading
+import urllib.request
+
+class Client:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fetch(self, url):
+        with self._lock:
+            return urllib.request.urlopen(url).read()
+"""
+    findings = concurrency.lint_source(src, "fix.py")
+    assert "DL4J-C002" in _codes(findings)
+    (f,) = [f for f in findings if f.code == "DL4J-C002"]
+    assert f.symbol == "Client.fetch" and "urlopen" in f.message
+
+
+def test_c003_untimed_blocking_calls():
+    src = """
+def drain(q, t, fut):
+    a = q.get()
+    t.join()
+    b = fut.result()
+    c = q.get(timeout=1.0)      # timed: fine
+    fut.result(timeout=2.0)     # timed: fine
+    return a, b, c
+"""
+    findings = concurrency.lint_source(src, "fix.py")
+    assert _codes(findings) == ["DL4J-C003"] * 3
+
+
+def test_c004_non_daemon_thread():
+    src = """
+import threading
+
+def spawn_bad(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+
+def spawn_good(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+def spawn_good_attr(fn):
+    t = threading.Thread(target=fn)
+    t.daemon = True
+    t.start()
+    return t
+"""
+    findings = concurrency.lint_source(src, "fix.py")
+    assert _codes(findings) == ["DL4J-C004"]
+    assert findings[0].symbol == "spawn_bad"
+
+
+def test_c005_guarded_attr_written_outside_lock():
+    src = """
+import threading
+from deeplearning4j_tpu.analysis import guarded_by
+
+@guarded_by("_lock", "items", "n")
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []          # __init__ exempt: no concurrency yet
+        self.n = 0
+
+    def add_bad(self, x):
+        self.items.append(x)
+        self.n += 1
+
+    def add_good(self, x):
+        with self._lock:
+            self.items.append(x)
+            self.n += 1
+"""
+    findings = concurrency.lint_source(src, "fix.py")
+    assert _codes(findings) == ["DL4J-C005"] * 2
+    assert all(f.symbol == "Box.add_bad" for f in findings)
+
+
+def test_suppression_comment_silences_a_finding():
+    src = """
+def f(q):
+    return q.get()  # analysis: ok(C003) — producer guaranteed alive
+"""
+    assert concurrency.lint_source(src, "fix.py") == []
+    # a suppression for a different code does NOT silence it
+    src_wrong = src.replace("C003", "C001")
+    assert _codes(concurrency.lint_source(src_wrong, "fix.py")) \
+        == ["DL4J-C003"]
+
+
+def test_clean_module_negative():
+    src = """
+import threading
+
+
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def push(self, x):
+        with self._lock:
+            self._buf.append(x)
+
+    def pop(self, q):
+        return q.get(timeout=5.0)
+"""
+    assert concurrency.lint_source(src, "clean.py") == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = concurrency.lint_source("def broken(:\n", "bad.py")
+    assert _codes(findings) == ["DL4J-C000"]
+
+
+def test_lint_tree_over_shipped_code_is_clean():
+    """The burn-down contract: the shipped tree has zero concurrency
+    findings (everything real was fixed, everything intentional is
+    suppressed inline)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = concurrency.lint_tree(repo)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_guarded_by_registers_and_validates():
+    @guarded_by("_lock", "a", "b")
+    @guarded_by("_cond", "c")
+    class X:
+        pass
+
+    assert X.__guarded_by__ == {"a": "_lock", "b": "_lock", "c": "_cond"}
+    with pytest.raises(ValueError):
+        guarded_by("_lock")
+
+
+# --------------------------------------------------------------------------
+# jaxpr hazard lint
+# --------------------------------------------------------------------------
+
+def test_j001_f32_matmul_under_bf16_policy():
+    def f(x, w):
+        return jnp.dot(x, w)
+
+    x = jnp.ones((2, 3), jnp.float32)
+    w = jnp.ones((3, 4), jnp.float32)
+    closed = jax.make_jaxpr(f)(x, w)
+    findings = jaxpr_lint._check_ir(closed, "fixture", "bfloat16")
+    assert "DL4J-J001" in _codes(findings)
+    # same program under an f32 policy: the matmul dtype matches, clean
+    f32 = [f for f in jaxpr_lint._check_ir(closed, "fixture", "float32")
+           if f.code == "DL4J-J001"]
+    assert f32 == []
+
+
+def test_j002_float64_promotion():
+    def f(x):
+        return x + jnp.float64(1.0)
+
+    closed = jax.make_jaxpr(f)(jnp.ones((2,), jnp.float64))
+    assert "DL4J-J002" in _codes(
+        jaxpr_lint._check_ir(closed, "fixture", "float32"))
+
+
+def test_j003_retrace_bomb_from_baked_in_scalar():
+    def f(x, n):
+        return x * n
+
+    jit_fn = jax.jit(f, static_argnums=(1,))
+    x = jnp.ones((3,), jnp.float32)
+    # value-varied, shape-identical: the static scalar bakes into the
+    # trace, so the two lowerings differ — the retrace bomb signature
+    text_a = jit_fn.lower(x, 2).as_text()
+    text_b = jit_fn.lower(x, 3).as_text()
+    assert _codes(jaxpr_lint._check_retrace(text_a, text_b, "fixture")) \
+        == ["DL4J-J003"]
+    # a traced (non-static) argument is value-independent: clean
+    jit_ok = jax.jit(f)
+    ok_a = jit_ok.lower(x, 2.0).as_text()
+    ok_b = jit_ok.lower(x, 3.0).as_text()
+    assert jaxpr_lint._check_retrace(ok_a, ok_b, "fixture") == []
+
+
+def test_j004_donation_markers():
+    def step(params, x):
+        return params - 0.1 * x, x
+
+    x = jnp.ones((4,), jnp.float32)
+    with_don = jax.jit(step, donate_argnums=(0,)).lower(x, x).as_text()
+    without = jax.jit(step).lower(x, x).as_text()
+    assert jaxpr_lint._check_donation(with_don, "fixture") == []
+    assert _codes(jaxpr_lint._check_donation(without, "fixture")) \
+        == ["DL4J-J004"]
+
+
+def test_j005_off_allowlist_primitive():
+    def f(x):
+        return jnp.linalg.cholesky(x)
+
+    closed = jax.make_jaxpr(f)(jnp.eye(3, dtype=jnp.float32))
+    found = jaxpr_lint._check_ir(closed, "fixture", "float32")
+    assert any(f.code == "DL4J-J005" and "cholesky" in f.message
+               for f in found)
+
+
+def test_shipped_forward_target_is_clean():
+    """One real end-to-end target (the cheapest) traces clean — the
+    full six-target sweep runs in scripts/static_check.py."""
+    assert jaxpr_lint.lint_target("mnist_mlp.forward") == []
+
+
+def test_unknown_failure_surfaces_as_j000():
+    jaxpr_lint.TARGETS["_boom"] = lambda: (_ for _ in ()).throw(
+        RuntimeError("fixture blew up"))
+    try:
+        findings = jaxpr_lint.lint_target("_boom")
+    finally:
+        del jaxpr_lint.TARGETS["_boom"]
+    assert _codes(findings) == ["DL4J-J000"]
+    assert "fixture blew up" in findings[0].message
+
+
+# --------------------------------------------------------------------------
+# runtime lock-order detector
+# --------------------------------------------------------------------------
+
+def _opposed_acquire(lock_ab, lock_ba):
+    """Acquire the two locks in opposite orders on two threads (with a
+    barrier so both outer acquisitions happen before either inner one
+    is attempted — but released in between, so no actual deadlock)."""
+    a, b = lock_ab
+    b2, a2 = lock_ba
+
+    def order(first, second):
+        with first:
+            pass
+        with first:
+            with second:
+                pass
+
+    t1 = threading.Thread(target=order, args=(a, b), daemon=True)
+    t2 = threading.Thread(target=order, args=(b2, a2), daemon=True)
+    t1.start(); t1.join(timeout=10.0)
+    t2.start(); t2.join(timeout=10.0)
+
+
+def test_lockorder_detects_cycle_in_private_graph():
+    # private graph: the intentional cycle must not trip the session-wide
+    # conftest gate on the global graph
+    g = lockorder.LockOrderGraph()
+    raw_a, raw_b = threading.Lock(), threading.Lock()
+    a = lockorder.instrument(raw_a, name="fixture_A", graph=g)
+    b = lockorder.instrument(raw_b, name="fixture_B", graph=g)
+    _opposed_acquire((a, b), (b, a))
+    cycles = g.cycles()
+    assert cycles, "opposite-order acquisitions must form a cycle"
+    assert {"fixture_A", "fixture_B"} <= set(cycles[0])
+    findings = g.findings()
+    assert _codes(findings) == ["DL4J-L001"]
+    assert "fixture_A" in findings[0].message
+
+
+def test_lockorder_consistent_order_is_clean():
+    g = lockorder.LockOrderGraph()
+    a = lockorder.instrument(threading.Lock(), name="ord_A", graph=g)
+    b = lockorder.instrument(threading.Lock(), name="ord_B", graph=g)
+    _opposed_acquire((a, b), (a, b))   # both threads: A then B
+    assert g.cycles() == []
+    assert g.findings() == []
+
+
+def test_lockorder_condition_wait_notify_roundtrip():
+    """InstrumentedLock must satisfy the Condition lock protocol
+    (_release_save/_acquire_restore/_is_owned) — wait/notify round-trips
+    through an instrumented lock."""
+    lk = lockorder.instrument(threading.Lock(), name="cond_fixture",
+                              graph=lockorder.LockOrderGraph())
+    cond = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                if not cond.wait(timeout=10.0):
+                    return
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        hits.append("set")
+        cond.notify_all()
+    t.join(timeout=10.0)
+    assert hits == ["set", "woke"]
+
+
+def test_lockorder_records_long_hold_span():
+    from deeplearning4j_tpu.observability.trace import Tracer, set_tracer, \
+        get_tracer
+    prev = get_tracer()
+    tracer = Tracer()
+    set_tracer(tracer)
+    try:
+        lk = lockorder.instrument(threading.Lock(), name="hold_fixture",
+                                  graph=lockorder.LockOrderGraph())
+        with lk:
+            time.sleep(0.08)   # > the 50 ms default threshold
+    finally:
+        set_tracer(prev)
+    spans = [s for s in tracer.spans() if s.name == "lock_hold"]
+    assert spans and spans[0].attrs["lock"] == "hold_fixture"
+    assert spans[0].dur_us >= 50_000   # microseconds
+
+
+def test_lockorder_install_is_active_under_pytest():
+    """conftest turns the detector on by default; locks allocated by the
+    suite are instrumented transparently."""
+    assert lockorder.installed()
+    lk = threading.Lock()
+    assert isinstance(lk, lockorder.InstrumentedLock)
+    with lk:        # plain usage unaffected
+        assert lk.locked()
+    assert not lk.locked()
+
+
+# --------------------------------------------------------------------------
+# the static_check baseline gate
+# --------------------------------------------------------------------------
+
+def test_static_check_shipped_tree_passes(capsys):
+    """The CI contract: the committed tree + committed baseline exit 0.
+    (--skip-jaxpr keeps this one fast; the full sweep including the
+    six-target jaxpr trace runs in test_static_check_full_gate.)"""
+    rc = static_check.main(["--skip-jaxpr"])
+    assert rc == 0
+    assert "static_check: OK" in capsys.readouterr().out
+
+
+def test_static_check_full_gate(capsys):
+    """The tier-1 hook for the whole subsystem: the full gate — AST
+    sweep + all six jaxpr targets traced — against the committed
+    baseline, exactly as CI invokes it (~6 s, host-only tracing)."""
+    rc = static_check.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "static_check: OK" in out
+
+
+def test_static_check_fails_on_new_finding(tmp_path, capsys):
+    baseline = tmp_path / "base.json"
+    baseline.write_text(json.dumps({"findings": {}}))
+    new = Finding(code="DL4J-C003", path="x.py", line=3, symbol="f",
+                  message="untimed q.get()")
+    problems = static_check.gate([new], static_check.load_baseline(
+        str(baseline)))
+    assert len(problems) == 1 and problems[0].startswith("NEW")
+
+
+def test_static_check_fails_on_stale_baseline_and_update_fixes(
+        tmp_path, capsys):
+    """Doctored baseline: an entry for a finding that no longer occurs
+    must fail the gate (a fixed hazard could silently return) until
+    --update-baseline shrinks it."""
+    doctored = tmp_path / "doctored.json"
+    doctored.write_text(json.dumps({"findings": {
+        "DL4J-C003|ghost.py|gone|untimed q.get()": 1}}))
+    rc = static_check.main(["--skip-jaxpr", "--baseline", str(doctored)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "STALE" in out and "--update-baseline" in out
+
+    rc = static_check.main(["--skip-jaxpr", "--baseline", str(doctored),
+                            "--update-baseline"])
+    assert rc == 0
+    assert static_check.load_baseline(str(doctored)) == {}
+    rc = static_check.main(["--skip-jaxpr", "--baseline", str(doctored)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_static_check_json_output(tmp_path, capsys):
+    out_path = tmp_path / "findings.json"
+    rc = static_check.main(["--skip-jaxpr", "--json", str(out_path)])
+    capsys.readouterr()
+    assert rc == 0
+    assert json.loads(out_path.read_text()) == []   # clean tree
+
+
+def test_finding_roundtrip_and_ordering():
+    a = Finding(code="DL4J-C003", path="b.py", line=9, symbol="g",
+                message="m")
+    b = Finding(code="DL4J-C001", path="a.py", line=2, symbol="f",
+                message="m")
+    assert Finding.from_dict(a.to_dict()) == a
+    assert a.fingerprint() == "DL4J-C003|b.py|g|m"
+    assert sort_findings([a, b]) == [b, a]
