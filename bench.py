@@ -565,6 +565,22 @@ def run_config(name: str) -> dict:
             "compile_count": rep["metrics"]["compile_count"],
             "model": rep["model"],
         }
+    if name == "decode":
+        # sessionful decode goodput: the chunked-prefill + COW
+        # prefix-sharing serving arm (scripts/serve_bench.py --decode has
+        # the full TRANSFORMER_r02 report; this is the fast tracked entry)
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "serve_bench.py")
+        spec = importlib.util.spec_from_file_location("serve_bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rep = mod.bench_decode(sessions=6, gen_tokens=12)
+        return {k: rep.get(k) for k in (
+            "decode_tokens_per_sec", "inter_token_p50_ms",
+            "inter_token_p99_ms", "decode_bit_identical", "logits_exact",
+            "chunk_interleave_ratio", "pool_dedup_ratio",
+            "compile_delta_after_warm", "model")}
     if name == "mixed_precision":
         return bench_mixed_precision()
     raise ValueError(f"unknown bench config '{name}'")
@@ -617,9 +633,9 @@ def _timed(fn) -> float:
 
 
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
-            "transformer", "serving", "host_loop", "trace_overhead",
-            "goodput_overhead", "identity_overhead", "lockcheck_overhead",
-            "input_pipeline", "mixed_precision")
+            "transformer", "serving", "decode", "host_loop",
+            "trace_overhead", "goodput_overhead", "identity_overhead",
+            "lockcheck_overhead", "input_pipeline", "mixed_precision")
 
 
 def main():

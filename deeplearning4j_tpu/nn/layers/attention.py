@@ -25,11 +25,15 @@ Two arithmetic paths, one tolerance seam:
 
 The two paths agree to dtype tolerance (f32 ~1e-6 relative, bf16 ~1e-2)
 — the same two-tier contract PRECISION.md documents for serving, pinned
-in tests/test_transformer.py. Masked (right-padded) prefill is supported
-on the ONE-SHOT streaming call only: per-row true lengths come from the
-features mask, junk key slots beyond a row's length sit above "pos" and
-are overwritten by later decode steps before they ever become visible.
-Chunked prefill requires unmasked (aligned) rows.
+in tests/test_transformer.py. Masked (right-padded) streaming calls are
+OUTPUT-exact from any cache frontier, not just pos 0: per-row true
+lengths come from the features mask, junk key slots beyond a row's
+length land at positions >= the row's new frontier — above everything
+the real tokens attend — and are overwritten by later steps before they
+ever become visible. That is what lets serving's chunked prefill extend
+a mid-sequence cache with mask-padded chunk buckets (the extend op in
+serving/decode.py) and stay bit-identical, pinned by
+tests/test_transformer.py::TestChunkedPrefillSharing.
 """
 
 from __future__ import annotations

@@ -19,6 +19,27 @@ part of the ticket:
   the one-shot masked prefill is bit-identical to feeding the prompt
   token-by-token (the fixed-extent-cache contract, ops/attention.py),
   so the padding is purely a throughput lever.
+- **Chunked prefill (PR 16).** A long prompt head-of-line-blocks every
+  running decode step sharing the replica, so prefill is split into
+  page-aligned chunks (``DL4J_TPU_PREFILL_CHUNK_PAGES`` pages each,
+  default 1, ``0`` = kill switch): the first chunk is an ordinary
+  masked prefill, each later chunk is an EXTEND ticket
+  ``[x [1,s,V], mask [1,s], *cache leaves]`` that advances the cache
+  from its current frontier. Decode steps from other sessions dispatch
+  between a session's chunk tickets, capping inter-token p99 at one
+  chunk's latency instead of one prompt's. Chunk buckets ride the same
+  power-of-two rung ladder (all rungs pre-warmed), so the compile count
+  stays flat; masked extension from a mid-sequence frontier is
+  bit-identical by the fixed-extent contract — padded positions land
+  beyond the new frontier and are never attended before being
+  overwritten.
+- **Prefix sharing (PR 16).** Sessions opening with the same system
+  prompt adopt each other's sealed cache pages: ``KVPagePool`` keys
+  full pages by exact token history, ``prefill`` asks
+  ``match_prefix`` for the longest resident chain, reconstructs the
+  cache frontier from the shared pages, and extends from there —
+  skipping the shared tokens' prefill compute entirely and storing each
+  shared page once (``prefix_sharing=`` kwarg / pool flag, default on).
 - **State travels with the ticket.** Each session's cache leaves (per
   layer: k/v [1, C, H, dh] f32 + pos [1] i32) are host rows concatenated
   by the batcher exactly like features, and the forward returns the
@@ -44,6 +65,7 @@ eviction — is BIT-IDENTICAL; streaming vs the training forward
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -63,16 +85,23 @@ __all__ = ["StreamingKVForward", "DecodeEngine", "DecodeSession"]
 
 class StreamingKVForward:
     """Stateless feats-list forward over a streaming net, shaped for
-    ``MicroBatcher``: 2 inputs = prefill, 1 + n_carries inputs = decode.
+    ``MicroBatcher``: feats arity IS the phase — 2 inputs = prefill,
+    1 + n_carries = decode, 2 + n_carries = extend (chunked prefill).
 
     Prefill ``[x [b,T,V], mask [b,T]]`` runs the masked one-shot
     streaming forward from a fresh fixed-extent cache and returns
     ``[last-real-token logits [b,V], *cache leaves]``. Decode
     ``[x [b,1,V], *cache leaves]`` advances every row's cache one token
-    and returns ``[logits [b,V], *new leaves]``. Leaves flatten in
-    deterministic (sorted-key) pytree order; warm-up's float32 zero rows
-    are cast to each leaf's canonical dtype on entry so the jit cache
-    sees ONE signature per bucket.
+    and returns ``[logits [b,V], *new leaves]``. Extend
+    ``[x [b,s,V], mask [b,s], *cache leaves]`` is the chunked-prefill
+    op: it advances each row's EXISTING cache by its masked segment from
+    the row's current frontier and returns the segment's last-real-token
+    logits plus the new leaves — bit-identical to feeding those tokens
+    one by one (mask-padded rows write only beyond their new frontier,
+    which later writes overwrite before anything attends there). Leaves
+    flatten in deterministic (sorted-key) pytree order; warm-up's
+    float32 zero rows are cast to each leaf's canonical dtype on entry
+    so the jit cache sees ONE signature per bucket.
     """
 
     def __init__(self, net):
@@ -85,6 +114,7 @@ class StreamingKVForward:
         self._depth = 0
         self._jit_prefill = jax.jit(self._prefill_impl)
         self._jit_decode = jax.jit(self._decode_impl)
+        self._jit_extend = jax.jit(self._extend_impl)
         self._carry_def = None
         # eager 1-row probe pins the carry treedef + canonical dtypes
         vocab = int(net.layers[0].conf.n_in)
@@ -149,6 +179,24 @@ class StreamingKVForward:
         new_leaves, _ = jax.tree_util.tree_flatten(self._extract(ns))
         return [out[:, 0, :]] + new_leaves
 
+    def _extend_impl(self, params, x, mask, *leaves):
+        # decode-style carry merge + prefill-style masked advance: each
+        # row extends its own cache from its pos frontier
+        carries = jax.tree_util.tree_unflatten(self._carry_def, list(leaves))
+        state = {ln: dict(sub) for ln, sub in self.net.state.items()}
+        for ln, sub in carries.items():
+            merged = dict(state.get(ln, {}))
+            merged.update(sub)
+            state[ln] = merged
+        out, ns = self.net._forward(params, state, x, train=False, rng=None,
+                                    fmask=mask)
+        lengths = jnp.maximum(
+            jnp.sum(mask.astype(jnp.int32), axis=1), 1)
+        logits = jnp.take_along_axis(
+            out, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        new_leaves, _ = jax.tree_util.tree_flatten(self._extract(ns))
+        return [logits] + new_leaves
+
     # ----------------------------------------------------------------- entry
     def __call__(self, feats: list):
         self._enter()
@@ -158,6 +206,12 @@ class StreamingKVForward:
                     self.net.params, self.net.state,
                     jnp.asarray(feats[0], jnp.float32),
                     jnp.asarray(feats[1], jnp.float32))
+            elif len(feats) == 2 + self.n_carries:
+                leaves = [jnp.asarray(f, dt)
+                          for f, dt in zip(feats[2:], self._carry_dtypes)]
+                out = self._jit_extend(
+                    self.net.params, jnp.asarray(feats[0], jnp.float32),
+                    jnp.asarray(feats[1], jnp.float32), *leaves)
             else:
                 leaves = [jnp.asarray(f, dt)
                           for f, dt in zip(feats[1:], self._carry_dtypes)]
@@ -187,17 +241,31 @@ class DecodeSession:
         return len(self.ids)
 
 
-@guarded_by("_lock", "_sessions", "prefills", "decode_steps", "reprefills")
+@guarded_by("_lock", "_sessions", "prefills", "decode_steps", "reprefills",
+            "prefill_chunks", "chunked_prefills", "interleaved_prefills",
+            "prefix_hits", "shared_tokens")
 class DecodeEngine:
     """Sessionful autoregressive decode over a ``ReplicaSet``.
 
-    ``prefill(sid, ids)`` admits a session (one-shot masked prompt
-    forward, cache leaves into the pool) and returns next-token logits;
-    ``step(sid, token)`` extends it one token. Both are synchronous per
-    session; cross-session throughput comes from the batcher's window
-    coalescing concurrent sessions' single-token steps into one bucket
-    forward (drive sessions from threads, as ``serve_bench --decode``
-    does).
+    ``prefill(sid, ids)`` admits a session (masked prompt forward in
+    page-aligned chunks, cache leaves into the pool) and returns
+    next-token logits; ``step(sid, token)`` extends it one token. Both
+    are synchronous per session; cross-session throughput comes from the
+    batcher's window coalescing concurrent sessions' single-token steps
+    into one bucket forward (drive sessions from threads, as
+    ``serve_bench --decode`` does).
+
+    PR 16 knobs — both default-on, each with a kill switch:
+
+    - ``prefill_chunk_pages`` (env ``DL4J_TPU_PREFILL_CHUNK_PAGES``,
+      default 1): pages per prefill chunk; ``0`` disables chunking so
+      prompts prefill one-shot as before.
+    - ``prefix_sharing`` (env ``DL4J_TPU_KV_PREFIX_SHARING``, default
+      on): adopt + publish shared prompt-prefix pages in the pool.
+
+    Both features require token-axis cache carries (the attention
+    ``[1, C, H, dh]`` shape) and silently stay off for nets without
+    them (e.g. pure-LSTM carries), preserving the legacy path.
     """
 
     def __init__(self, net, *, replicas: int = 1, pool: KVPagePool = None,
@@ -205,16 +273,34 @@ class DecodeEngine:
                  max_batch: int = 64, batch_window_ms: float = 2.0,
                  max_queue: int = 1024, min_batch: int = 2,
                  min_prompt_bucket: int = 8, stats=None,
-                 request_timeout_s: float = 300.0):
+                 request_timeout_s: float = 300.0,
+                 prefix_sharing: Optional[bool] = None,
+                 prefill_chunk_pages: Optional[int] = None):
         self.forward = StreamingKVForward(net)
         self.fleet = ReplicaSet(self.forward, replicas, max_batch=max_batch,
                                 batch_window_ms=batch_window_ms,
                                 max_queue=max_queue, min_batch=min_batch,
                                 stats=stats)
+        if prefix_sharing is None:
+            prefix_sharing = os.environ.get(
+                "DL4J_TPU_KV_PREFIX_SHARING", "1").lower() \
+                not in ("0", "false", "no", "off")
+        if prefill_chunk_pages is None:
+            prefill_chunk_pages = int(os.environ.get(
+                "DL4J_TPU_PREFILL_CHUNK_PAGES", "1"))
         self.pool = pool if pool is not None \
-            else KVPagePool(n_pages, page_tokens)
+            else KVPagePool(n_pages, page_tokens,
+                            prefix_sharing=bool(prefix_sharing))
         self.min_prompt_bucket = int(min_prompt_bucket)
         self.max_prompt = self._max_prompt(net)
+        # both features need carries with a token axis to page/extend on
+        rs = self.forward.carry_row_shapes
+        can_page = (any(len(s) >= 2 for s in rs)
+                    and all(len(s) == 0 or len(s) >= 2 for s in rs))
+        self._sharing = (bool(prefix_sharing) and can_page
+                         and self.pool.prefix_sharing)
+        self._chunk_tokens = (max(0, int(prefill_chunk_pages))
+                              * self.pool.page_tokens if can_page else 0)
         self._sessions: Dict[str, DecodeSession] = {}
         self._lock = threading.Lock()
         # same-named knob as ModelServer: a dead fleet must fail a decode
@@ -223,6 +309,11 @@ class DecodeEngine:
         self.prefills = 0
         self.decode_steps = 0
         self.reprefills = 0   # evicted sessions re-admitted from history
+        self.prefill_chunks = 0        # prompt segments submitted
+        self.chunked_prefills = 0      # prefills split into >= 2 segments
+        self.interleaved_prefills = 0  # ...during which decode advanced
+        self.prefix_hits = 0           # prefills that adopted shared pages
+        self.shared_tokens = 0         # prefill tokens skipped via sharing
 
     @staticmethod
     def _max_prompt(net) -> int:
@@ -240,22 +331,50 @@ class DecodeEngine:
     def _prompt_bucket(self, t: int) -> int:
         return next_bucket(t, self.max_prompt, self.min_prompt_bucket)
 
-    def warm(self):
-        """Precompile both phase ladders: the decode bucket ladder (the
-        latency-critical one) and the prefill ladder for every prompt
-        rung."""
-        v = self.forward.vocab_size
-        compiled = list(self.fleet.warm(
-            [(1, v)] + list(self.forward.carry_row_shapes)))
-        t = self.min_prompt_bucket
-        rungs = []
-        while t < self.max_prompt:
+    def _extend_seg(self) -> int:
+        """Segment size (tokens) for extend tickets: the chunk size, or
+        one page when chunking is killed but a shared prefix still needs
+        extending from mid-sequence."""
+        seg = self._chunk_tokens if self._chunk_tokens \
+            else self.pool.page_tokens
+        return min(seg, self.max_prompt)
+
+    def _rungs(self, cap: int) -> List[int]:
+        """Every value ``next_bucket(seg, cap, min_prompt_bucket)`` can
+        produce for seg in 1..cap — the ladder a warm pass must cover."""
+        t, rungs = self.min_prompt_bucket, []
+        while t < cap:
             rungs.append(t)
             t *= 2
-        rungs.append(self.max_prompt)   # next_bucket caps at the extent
-        for t in rungs:
-            compiled += self.fleet.warm([(t, v), (t,)])
-        return compiled
+        rungs.append(cap)   # next_bucket caps at the extent
+        return rungs
+
+    def warm(self):
+        """Precompile every phase ladder: the decode bucket ladder (the
+        latency-critical one), the prefill ladder for every prompt rung,
+        and — when chunked prefill / prefix sharing is live — the extend
+        ladder, including the off-power edge rung where the cache extent
+        truncates the final chunk. Each ladder passes an explicit empty
+        ``skip`` to ``fleet.warm``: ``shapes_seen`` only records batch
+        buckets, so letting the default snapshot stand after the first
+        ladder would silently skip all the later ones and push their
+        compiles into the timed run."""
+        v = self.forward.vocab_size
+        carry = list(self.forward.carry_row_shapes)
+        compiled = list(self.fleet.warm([(1, v)] + carry, skip=()))
+        pf_cap = min(self._chunk_tokens, self.max_prompt) \
+            if self._chunk_tokens else self.max_prompt
+        for t in self._rungs(pf_cap):
+            compiled += self.fleet.warm([(t, v), (t,)], skip=())
+        if self._sharing or self._chunk_tokens:
+            ext = self._extend_seg()
+            ext_rungs = set(self._rungs(ext))
+            if self.max_prompt % ext:
+                ext_rungs.add(self.max_prompt % ext)
+            for t in sorted(ext_rungs):
+                compiled += self.fleet.warm([(t, v), (t,)] + carry,
+                                            skip=())
+        return sorted(set(compiled))
 
     def _await(self, fut, sid: str, what: str):
         try:
@@ -268,6 +387,22 @@ class DecodeEngine:
                 f"request_timeout_s={self.request_timeout_s:g}s") from None
 
     # ------------------------------------------------------------- lifecycle
+    def _leaves_from_partial(self, partial: dict, shared_t: int):
+        """Rebuild a full cache-leaf list from an adopted shared-page
+        prefix: token-axis carries get the shared slices below the
+        frontier (zeros above — never attended before overwrite), scalar
+        position carries become the frontier itself."""
+        leaves = []
+        for i, rs in enumerate(self.forward.carry_row_shapes):
+            dt = self.forward._carry_dtypes[i]
+            if i in partial:
+                arr = np.zeros((1,) + tuple(rs), dt)
+                arr[:, :shared_t] = partial[i]
+            else:
+                arr = np.full((1,) + tuple(rs), shared_t, dt)
+            leaves.append(arr)
+        return leaves
+
     def _run_prefill(self, sid: str, ids: List[int]) -> np.ndarray:
         t = len(ids)
         if t < 1:
@@ -275,14 +410,56 @@ class DecodeEngine:
         if t > self.max_prompt:
             raise ValueError(f"prompt of {t} tokens exceeds the cache "
                              f"extent {self.max_prompt}")
-        bt = self._prompt_bucket(t)
-        x = self._one_hot(ids, bt)
-        mask = np.zeros((1, bt), np.float32)
-        mask[0, :t] = 1.0
-        res = self._await(self.fleet.submit([x, mask], session=sid),
-                          sid, "prefill")
-        logits, leaves = res[0], list(res[1:])
-        self.pool.put(sid, t, leaves)
+        ext = self._extend_seg()
+        pos, leaves, logits = 0, None, None
+        if self._sharing:
+            # adopt the longest resident page chain of this prompt;
+            # alignment keeps later extend buckets on warmed rungs
+            shared_t, partial = self.pool.match_prefix(
+                sid, ids, align_tokens=ext)
+            if shared_t:
+                leaves = self._leaves_from_partial(partial, shared_t)
+                pos = shared_t
+                with self._lock:
+                    self.prefix_hits += 1
+                    self.shared_tokens += shared_t
+        ds0 = self.decode_steps
+        chunks = 0
+        while pos < t:
+            if leaves is None:
+                # fresh cache: masked prefill (whole prompt, or the
+                # first chunk when chunking is on)
+                cap = min(self._chunk_tokens, self.max_prompt) \
+                    if self._chunk_tokens else self.max_prompt
+                seg = min(t, cap)
+                bt = next_bucket(seg, cap, self.min_prompt_bucket)
+                x = self._one_hot(ids[:seg], bt)
+                mask = np.zeros((1, bt), np.float32)
+                mask[0, :seg] = 1.0
+                feats = [x, mask]
+            else:
+                # extend the existing cache by one page-aligned segment;
+                # the bucket cap never overruns the cache extent
+                cap = min(ext, self.max_prompt - pos)
+                seg = min(t - pos, cap)
+                bt = next_bucket(seg, cap, self.min_prompt_bucket)
+                x = self._one_hot(ids[pos:pos + seg], bt)
+                mask = np.zeros((1, bt), np.float32)
+                mask[0, :seg] = 1.0
+                feats = [x, mask] + list(leaves)
+            res = self._await(self.fleet.submit(feats, session=sid),
+                              sid, "prefill")
+            logits, leaves = res[0], list(res[1:])
+            pos += seg
+            chunks += 1
+        with self._lock:
+            self.prefill_chunks += chunks
+            if chunks > 1:
+                self.chunked_prefills += 1
+                if self.decode_steps > ds0:
+                    self.interleaved_prefills += 1
+        self.pool.put(sid, t, leaves,
+                      ids=ids if self._sharing else None)
         return logits[0], leaves
 
     def prefill(self, sid: str, ids: Sequence[int]) -> np.ndarray:
@@ -320,7 +497,11 @@ class DecodeEngine:
         sess.last_step = time.time()
         with self._lock:
             self.decode_steps += 1
-        self.pool.put(sid, sess.tokens, new_leaves)
+        # passing the history keeps sealing shareable pages as the
+        # session decodes; divergent continuations seal distinct keys,
+        # so shared prompt pages stay copy-on-write
+        self.pool.put(sid, sess.tokens, new_leaves,
+                      ids=sess.ids if self._sharing else None)
         return logits[0]
 
     def generate(self, sid: str, ids: Sequence[int], n_tokens: int,
@@ -343,6 +524,9 @@ class DecodeEngine:
     def close_session(self, sid: str) -> bool:
         with self._lock:
             known = self._sessions.pop(sid, None) is not None
+        # pool.drop releases this session's page references under the
+        # POOL lock (KVPagePool is @guarded_by): shared pages survive
+        # for their other holders, exclusively-held pages free here
         self.pool.drop(sid)
         self.fleet.forget_session(sid)
         return known
@@ -359,7 +543,14 @@ class DecodeEngine:
                  reprefills=self.reprefills,
                  affinity_hits=self.fleet.affinity_hits,
                  affinity_misses=self.fleet.affinity_misses,
-                 sessions_live=len(self._sessions))
+                 sessions_live=len(self._sessions),
+                 prefill_chunks=self.prefill_chunks,
+                 chunked_prefills=self.chunked_prefills,
+                 interleaved_prefills=self.interleaved_prefills,
+                 prefix_hits=self.prefix_hits,
+                 shared_tokens=self.shared_tokens,
+                 prefill_chunk_tokens=self._chunk_tokens,
+                 prefix_sharing=self._sharing)
         return d
 
     def stop(self):

@@ -139,7 +139,7 @@ class ReplicaSet:
         for r in self.replicas:
             r.batcher.stop()
 
-    def warm(self, row_shapes):
+    def warm(self, row_shapes, skip=None):
         """Hoisted warm-up: run the bucket ladder once per DISTINCT
         forward object. Replicas sharing one model/mesh share the jit
         cache, so the ladder compiles once no matter how many replicas
@@ -149,8 +149,16 @@ class ReplicaSet:
         skipped per batcher.warm — but only against the PRE-call
         snapshot, so when replicas carry distinct forwards each still
         warms its own full ladder. Returns the buckets actually
-        compiled by this call (sorted, deduped across forwards)."""
-        seen0 = set(self.shapes_seen)
+        compiled by this call (sorted, deduped across forwards).
+
+        ``shapes_seen`` holds bare batch-bucket ints with no notion of
+        WHICH row-shape ladder they came from, so a caller warming
+        several ladders in sequence (decode, then each prompt rung, as
+        ``DecodeEngine.warm`` does) must pass an explicit ``skip`` set —
+        otherwise the snapshot taken after the first ladder silently
+        suppresses every later one and those rungs compile during the
+        timed run."""
+        seen0 = set(self.shapes_seen) if skip is None else set(skip)
         warmed = set()
         compiled: set[int] = set()
         for r in self.replicas:

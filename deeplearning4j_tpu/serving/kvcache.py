@@ -1,37 +1,57 @@
-"""Paged KV-cache pool: block-granular admission + LRU eviction for
-decode sessions.
+"""Paged KV-cache pool: block-granular admission, copy-on-write prefix
+sharing, and refcounted LRU eviction for decode sessions.
 
 Autoregressive decode serving holds per-session state (each transformer
 layer's KV cache plus positions) between requests — unbounded sessions
 would grow that footprint without limit. This pool is the admission
 tier: capacity is fixed in PAGES of ``page_tokens`` tokens each, every
-session is charged ``ceil(tokens / page_tokens)`` pages for the prefix
-it has decoded so far, and when an allocation would overflow the pool
-the least-recently-used *other* session is evicted — its cached state is
-dropped and its pages return to the free pool.
+session is charged for the pages backing the prefix it has decoded so
+far, and when an allocation would overflow the pool the least-recently-
+used *other* session is released — its private state is dropped, its
+references on shared pages are decremented, and only pages nobody still
+holds return to the free pool.
 
-Eviction is RECOVERABLE, mirroring the replica tier's requeue stance
-(fleet.py): the decode engine keeps each session's token history (ints —
-thousands of times smaller than the KV tensors), so an evicted session
-that comes back is transparently re-prefilled from history before its
-next step. The session sees extra latency, never a wrong token: one-shot
-prefill is bit-identical to the step-by-step path it replaces
-(tests/test_transformer.py pins this), so recovery is invisible in the
-output stream.
+**Prefix sharing (the PR 16 tentpole).** Sessions that begin with the
+same tokens — the shared-system-prompt shape — produce bit-identical
+cache pages (the fixed-extent exact-lowering contract, ops/attention.py),
+so FULL pages are keyed by the exact token-history prefix that produced
+them: ``tuple(ids[:page_end])``. A ``put`` that seals a page whose key
+already exists takes a reference on the existing page instead of storing
+a second copy; ``match_prefix`` lets a brand-new session adopt the
+longest already-resident page chain of its prompt and skip that much
+prefill compute. The key is the exact prefix, not a digest — two
+different histories can never alias onto one page, which is what keeps
+the decode bit-identity oracle satisfiable. Sharing is copy-on-write by
+construction: shared pages are immutable; every session's growing edge
+lives in a private TAIL (the partial last page plus the non-pageable
+leaves such as positions), so a session that diverges mid-page simply
+seals its own distinct page later — no shared state is ever mutated.
 
-The pool stores each session's cache leaves verbatim (dense per-session
-tensors, host-side numpy rows); "paged" here is the ACCOUNTING contract
-— block-granular occupancy and eviction à la paged attention — not
-physical page sharing between sessions. Occupancy (`pages_used /
-n_pages`) and the eviction counter feed ``serve_bench --decode`` and the
-metrics registry.
+Eviction is RECOVERABLE and refcounted: evicting a session releases its
+references, and a page survives as long as ANY holder remains
+(evict-while-shared keeps it; the last holder's release frees it). The
+evicted session's token history (kept by the engine, tiny) re-prefills
+it transparently on its next step — and the re-prefill itself re-adopts
+whatever pages its peers kept alive, so recovery after an eviction of a
+shared session is cheap as well as bit-identical.
+
+Legacy behavior is preserved: a ``put`` without ``ids``, or with leaves
+the pool cannot page (no ``[1, extent, ...]`` cache axes — e.g. LSTM
+``h``/``c`` carries, or the plain strings the accounting tests store),
+falls back to the original dense per-session storage with pure
+page-count accounting. Occupancy, the dedup ratio, and the shared-page
+gauge feed ``serve_bench --decode`` and the metrics registry.
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import List
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.analysis.guards import guarded_by
 
 __all__ = ["KVPagePool", "CachePoolFullError"]
 
@@ -41,35 +61,96 @@ class CachePoolFullError(RuntimeError):
     admission must reject it (no amount of eviction can fit it)."""
 
 
-class KVPagePool:
-    """Fixed-capacity page accounting + LRU store for decode-session
-    cache state.
+class _Page:
+    """One immutable shared page: a refcount plus, per pageable leaf,
+    the ``[1, page_tokens, ...]`` slice of that leaf's token axis."""
 
-    ``put`` charges/extends a session and stores its cache leaves,
-    evicting least-recently-used other sessions as needed; ``get``
-    retrieves (and LRU-touches) them; a ``get`` returning ``None`` means
-    the session was evicted and must be re-prefilled from history.
+    __slots__ = ("ref", "slices")
+
+    def __init__(self, slices):
+        self.ref = 1
+        self.slices = slices
+
+
+class _Entry:
+    """Per-session pool record. ``dense`` holds the legacy verbatim
+    leaves; paged sessions instead hold a chain of shared-page keys plus
+    a private tail (partial-page slices + non-pageable leaves)."""
+
+    __slots__ = ("tokens", "dense", "chain", "tail", "others")
+
+    def __init__(self):
+        self.tokens = 0
+        self.dense = None            # legacy verbatim leaves (or None)
+        self.chain: List[tuple] = []  # shared-page keys, page order
+        self.tail = None             # per-pageable-leaf [1, r, ...] slices
+        self.others: List[Tuple[int, object]] = []  # (leaf idx, leaf)
+
+    @property
+    def paged(self) -> bool:
+        return self.dense is None
+
+
+@guarded_by("_lock", "_table", "_shared", "_layout", "evictions",
+            "evicted_pages", "page_hits", "prefix_matches")
+class KVPagePool:
+    """Fixed-capacity page accounting + copy-on-write store for
+    decode-session cache state.
+
+    ``put`` charges/extends a session and stores its cache leaves
+    (deduplicating sealed full pages against the shared store when
+    ``ids`` is given), evicting least-recently-used other sessions as
+    needed; ``get`` reassembles (and LRU-touches) them; a ``get``
+    returning ``None`` means the session was evicted and must be
+    re-prefilled from history. ``match_prefix`` adopts an existing
+    sessions' pages for a new prompt sharing their prefix.
     """
 
-    def __init__(self, n_pages: int = 256, page_tokens: int = 16):
+    def __init__(self, n_pages: int = 256, page_tokens: int = 16,
+                 prefix_sharing: bool = True):
         if n_pages < 1 or page_tokens < 1:
             raise ValueError("n_pages and page_tokens must be >= 1")
         self.n_pages = int(n_pages)
         self.page_tokens = int(page_tokens)
+        self.prefix_sharing = bool(prefix_sharing)
         self._lock = threading.Lock()
-        # sid -> (pages_held, cache leaves); insertion order = LRU order
-        self._table: OrderedDict[str, tuple] = OrderedDict()
+        # sid -> _Entry; insertion order = LRU order
+        self._table: "OrderedDict[str, _Entry]" = OrderedDict()
+        # exact token-prefix tuple -> shared _Page
+        self._shared: Dict[tuple, _Page] = {}
+        # (n_leaves, pageable idx tuple, per-pageable extent, dtypes) —
+        # pinned by the first paged put; one pool serves one model
+        self._layout = None
         self.evictions = 0          # sessions dropped to free pages
-        self.evicted_pages = 0      # pages reclaimed by those drops
+        self.evicted_pages = 0      # pages actually freed by those drops
+        self.page_hits = 0          # sealed pages deduped against peers
+        self.prefix_matches = 0     # match_prefix adoptions
 
     # ------------------------------------------------------------ accounting
     def pages_for(self, tokens: int) -> int:
         return max(1, -(-int(tokens) // self.page_tokens))
 
+    def _physical_locked(self) -> int:
+        """Distinct pages actually held: each shared page once, plus
+        every session's private tail / dense charge."""
+        used = len(self._shared)
+        for ent in self._table.values():
+            if ent.paged:
+                used += 1 if ent.tail is not None else 0
+            else:
+                used += self.pages_for(ent.tokens)
+        return used
+
+    def _logical_locked(self) -> int:
+        """Page charge as if nothing were shared — the numerator of the
+        dedup ratio."""
+        return sum(self.pages_for(ent.tokens)
+                   for ent in self._table.values())
+
     @property
     def pages_used(self) -> int:
         with self._lock:
-            return sum(p for p, _ in self._table.values())
+            return self._physical_locked()
 
     @property
     def occupancy(self) -> float:
@@ -82,46 +163,231 @@ class KVPagePool:
 
     def describe(self) -> dict:
         with self._lock:
-            used = sum(p for p, _ in self._table.values())
+            used = self._physical_locked()
+            logical = self._logical_locked()
+            shared = sum(1 for p in self._shared.values() if p.ref >= 2)
             return {"n_pages": self.n_pages, "page_tokens": self.page_tokens,
                     "pages_used": used, "occupancy": used / self.n_pages,
                     "sessions": len(self._table),
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "prefix_sharing": self.prefix_sharing,
+                    "shared_pages": shared,
+                    "store_pages": len(self._shared),
+                    "logical_pages": logical,
+                    "dedup_ratio": (round(logical / used, 4) if used
+                                    else None),
+                    "page_hits": self.page_hits,
+                    "prefix_matches": self.prefix_matches}
+
+    # ------------------------------------------------------------- internals
+    def _pageable_layout(self, tokens: int, leaves) -> Optional[tuple]:
+        """Detect the pageable leaves: ``[1, extent, ...]`` arrays whose
+        token axis covers this session. Returns the layout tuple, or
+        ``None`` when nothing is pageable (dense fallback)."""
+        idx, extents, dtypes = [], [], []
+        for i, leaf in enumerate(leaves):
+            shape = getattr(leaf, "shape", None)
+            if (shape is not None and getattr(leaf, "ndim", 0) >= 3
+                    and shape[0] == 1 and shape[1] >= tokens):
+                idx.append(i)
+                extents.append(int(shape[1]))
+                dtypes.append(leaf.dtype)
+        if not idx:
+            return None
+        return (len(list(leaves)), tuple(idx), tuple(extents),
+                tuple(dtypes))
+
+    def _release_locked(self, ent: _Entry) -> int:
+        """Drop a session's holdings: decrement its chain refs (freeing
+        pages at zero), drop its tail/dense charge. Returns pages freed."""
+        freed = 0
+        if not ent.paged:
+            return self.pages_for(ent.tokens)
+        for key in ent.chain:
+            page = self._shared.get(key)
+            if page is None:
+                continue
+            page.ref -= 1
+            if page.ref <= 0:
+                del self._shared[key]
+                freed += 1
+        if ent.tail is not None:
+            freed += 1
+        ent.chain, ent.tail, ent.others = [], None, []
+        return freed
+
+    def _evict_locked(self, keep_sid: str) -> None:
+        """LRU-release other sessions until the pool fits. A victim all
+        of whose pages are shared frees nothing by itself — survivors
+        keep those pages — so the sweep continues to the next victim."""
+        while self._physical_locked() > self.n_pages:
+            victim = next((s for s in self._table if s != keep_sid), None)
+            if victim is None:
+                break   # only keep_sid remains; its own charge fits
+            ent = self._table.pop(victim)
+            self.evictions += 1
+            self.evicted_pages += self._release_locked(ent)
 
     # ----------------------------------------------------------------- store
-    def put(self, sid: str, tokens: int, leaves) -> None:
+    def put(self, sid: str, tokens: int, leaves, ids=None) -> None:
         """Store/refresh ``sid``'s cache leaves and charge it for
         ``tokens`` decoded tokens, evicting LRU peers if the pool is
-        full. Raises ``CachePoolFullError`` when the session alone
-        exceeds pool capacity."""
+        full. With ``ids`` (the session's full token history, one id per
+        token) and pageable leaves, sealed full pages are deduplicated
+        against the shared store by exact prefix key. Raises
+        ``CachePoolFullError`` when the session alone exceeds pool
+        capacity."""
         need = self.pages_for(tokens)
         if need > self.n_pages:
             raise CachePoolFullError(
                 f"session '{sid}' needs {need} pages "
                 f"({tokens} tokens @ {self.page_tokens}/page) but the "
                 f"pool holds {self.n_pages}")
+        tokens = int(tokens)
+        layout = None
+        if self.prefix_sharing and ids is not None and len(ids) == tokens:
+            layout = self._pageable_layout(tokens, leaves)
         with self._lock:
-            self._table.pop(sid, None)   # re-charge at the new token count
-            used = sum(p for p, _ in self._table.values())
-            while used + need > self.n_pages:
-                _victim, (vpages, _) = self._table.popitem(last=False)
-                self.evictions += 1
-                self.evicted_pages += vpages
-                used -= vpages
-            self._table[sid] = (need, leaves)
+            ent = self._table.pop(sid, None)
+            if layout is None:
+                # legacy dense path (accounting-only, leaves verbatim)
+                if ent is not None:
+                    self._release_locked(ent)
+                ent = _Entry()
+                ent.tokens, ent.dense = tokens, leaves
+                self._table[sid] = ent
+                self._evict_locked(sid)
+                return
+            if self._layout is None:
+                self._layout = layout
+            if ent is None or not ent.paged:
+                if ent is not None:
+                    self._release_locked(ent)
+                ent = _Entry()
+            pt = self.page_tokens
+            idst = tuple(int(i) for i in ids)
+            n_full = tokens // pt
+            # a re-prefill with a DIFFERENT history (sid reuse) must not
+            # extend the stale chain — release and rebuild
+            if ent.chain and (len(ent.chain) > n_full or ent.chain[-1]
+                              != idst[:len(ent.chain) * pt]):
+                self.evicted_pages += self._release_locked(ent)
+            # the old tail is superseded by this put's fresh slices
+            ent.tail = None
+            pageable = layout[1]
+            for p in range(len(ent.chain), n_full):
+                key = idst[:(p + 1) * pt]
+                page = self._shared.get(key)
+                if page is not None:
+                    page.ref += 1
+                    self.page_hits += 1
+                else:
+                    page = _Page([np.ascontiguousarray(
+                        leaves[i][:, p * pt:(p + 1) * pt])
+                        for i in pageable])
+                    self._shared[key] = page
+                ent.chain.append(key)
+            rem = tokens - n_full * pt
+            if rem or not ent.chain:
+                # always hold >= the admission floor of one page
+                ent.tail = [np.ascontiguousarray(
+                    leaves[i][:, n_full * pt:tokens]) for i in pageable]
+            ent.others = [(i, leaves[i]) for i in range(layout[0])
+                          if i not in pageable]
+            ent.tokens = tokens
+            ent.dense = None
+            self._table[sid] = ent
+            self._evict_locked(sid)
 
     def get(self, sid: str):
         """Cache leaves for ``sid`` (LRU-touched), or ``None`` if the
-        session was evicted (caller re-prefills from token history)."""
+        session was evicted (caller re-prefills from token history).
+        Paged sessions are reassembled to full-extent arrays; positions
+        beyond the token frontier are zeros, which the fixed-extent
+        attention never reads before overwriting."""
         with self._lock:
-            entry = self._table.pop(sid, None)
-            if entry is None:
+            ent = self._table.pop(sid, None)
+            if ent is None:
                 return None
-            self._table[sid] = entry   # move to MRU end
-            return entry[1]
+            self._table[sid] = ent   # move to MRU end
+            if not ent.paged:
+                return ent.dense
+            n_leaves, pageable, extents, dtypes = self._layout
+            leaves: List[object] = [None] * n_leaves
+            pt = self.page_tokens
+            for j, i in enumerate(pageable):
+                parts = [self._shared[key].slices[j] for key in ent.chain]
+                if ent.tail is not None:
+                    parts.append(ent.tail[j])
+                row = parts[0].shape[2:]
+                arr = np.zeros((1, extents[j]) + tuple(row), dtypes[j])
+                if ent.tokens:
+                    arr[:, :ent.tokens] = np.concatenate(parts, axis=1) \
+                        if len(parts) > 1 else parts[0]
+                leaves[i] = arr
+            for i, leaf in ent.others:
+                leaves[i] = leaf
+            return leaves
+
+    def match_prefix(self, sid: str, ids, align_tokens: Optional[int] = None
+                     ) -> Tuple[int, Optional[dict]]:
+        """Adopt the longest resident page chain matching a prefix of
+        ``ids`` for a NEW session ``sid``: takes a reference on each
+        matched page and installs the session's chain, so the caller can
+        skip prefill compute for the covered tokens. Returns
+        ``(n_tokens_covered, {leaf idx: [1, n, ...] partial})`` — or
+        ``(0, None)`` when nothing matches. Always leaves at least one
+        prompt token uncovered (the caller still needs logits for the
+        last prompt token), and caps coverage at a multiple of
+        ``align_tokens`` so the caller's segment ladder stays on its
+        warmed rungs."""
+        if not self.prefix_sharing:
+            return 0, None
+        with self._lock:
+            if self._layout is None:
+                return 0, None
+            pt = self.page_tokens
+            idst = tuple(int(i) for i in ids)
+            limit = (len(idst) - 1) // pt
+            if align_tokens:
+                step = max(1, int(align_tokens) // pt)
+                limit -= limit % step
+            chain = []
+            for p in range(limit):
+                page = self._shared.get(idst[:(p + 1) * pt])
+                if page is None:
+                    break
+                chain.append(idst[:(p + 1) * pt])
+            if align_tokens:
+                step = max(1, int(align_tokens) // pt)
+                chain = chain[:len(chain) - (len(chain) % step)]
+            if not chain:
+                return 0, None
+            old = self._table.pop(sid, None)
+            if old is not None:
+                self._release_locked(old)
+            for key in chain:
+                self._shared[key].ref += 1
+            ent = _Entry()
+            ent.chain = list(chain)
+            ent.tokens = len(chain) * pt
+            self._table[sid] = ent
+            self.prefix_matches += 1
+            _, pageable, _, _ = self._layout
+            partial = {}
+            for j, i in enumerate(pageable):
+                parts = [self._shared[key].slices[j] for key in chain]
+                partial[i] = (np.concatenate(parts, axis=1)
+                              if len(parts) > 1 else parts[0])
+            return ent.tokens, partial
 
     def drop(self, sid: str) -> bool:
-        """Voluntary release (session closed) — frees its pages without
-        counting as an eviction."""
+        """Voluntary release (session closed) — decrements this
+        session's page references and frees whatever nobody else still
+        holds, without counting as an eviction."""
         with self._lock:
-            return self._table.pop(sid, None) is not None
+            ent = self._table.pop(sid, None)
+            if ent is None:
+                return False
+            self._release_locked(ent)
+            return True

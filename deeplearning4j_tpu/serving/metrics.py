@@ -291,3 +291,50 @@ class ServingStats:
         if reg is not None:
             reg.unregister_collector(self._collector)
             self._registry = self._collector = None
+
+
+# ------------------------------------------------- decode-tier families
+def decode_metric_families(describe: dict, labels=None):
+    """Render a ``DecodeEngine.describe()`` dict into MetricFamily rows
+    for the unified registry — the decode/KV-pool view of ``/metrics``
+    (Prometheus text + JSON) and, because ``export_snapshot`` reads the
+    same registry, the federation wire form. Registered as a render-time
+    collector by ``ModelServer`` when a decode engine is attached."""
+    from deeplearning4j_tpu.observability.metrics import MetricFamily
+
+    L = dict(labels or {})
+    fams = []
+
+    def fam(name, kind, help, value):
+        if value is None:
+            return
+        fams.append(MetricFamily(name, kind, help).add(value, L))
+
+    fam("dl4j_kv_pool_pages_used", "gauge",
+        "Physical KV pages held (each shared page counted once)",
+        describe.get("pages_used"))
+    fam("dl4j_kv_pool_shared_pages", "gauge",
+        "KV pages currently referenced by two or more sessions",
+        describe.get("shared_pages"))
+    fam("dl4j_kv_pool_dedup_ratio", "gauge",
+        "Logical page charge over physical pages held (1.0 = nothing "
+        "shared)", describe.get("dedup_ratio"))
+    fam("dl4j_kv_pool_evictions_total", "counter",
+        "Sessions LRU-released to free pages",
+        describe.get("evictions"))
+    fam("dl4j_decode_prefill_chunks_total", "counter",
+        "Prompt segments submitted through the chunked-prefill path",
+        describe.get("prefill_chunks"))
+    fam("dl4j_decode_interleaved_prefills_total", "counter",
+        "Chunked prefills during which decode steps dispatched between "
+        "chunks", describe.get("interleaved_prefills"))
+    fam("dl4j_decode_prefix_hits_total", "counter",
+        "Prefills that adopted a shared prompt-prefix page chain",
+        describe.get("prefix_hits"))
+    fam("dl4j_decode_shared_tokens_total", "counter",
+        "Prefill tokens skipped by adopting shared pages",
+        describe.get("shared_tokens"))
+    fam("dl4j_decode_reprefills_total", "counter",
+        "Evicted sessions re-admitted bit-identically from history",
+        describe.get("reprefills"))
+    return fams

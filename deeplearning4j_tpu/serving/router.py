@@ -396,20 +396,36 @@ class FrontDoorRouter:
                                else self._pick(exclude=tried)))
             return status, data, headers
         if op == "close":
+            # broadcast to EVERY live host, not just the pinned one: a
+            # session that failed over (or whose prefix pages were
+            # adopted after an eviction elsewhere) holds pool pages on
+            # hosts it is no longer pinned to, and close must release
+            # those page references fleet-wide. Close is idempotent on
+            # hosts that never saw the sid, so this needs no protocol
+            # change — `closed` reports whether ANY host knew it.
             with self._lock:
                 self._history.pop(sid, None)
                 pinned = self._affinity.pop(sid, None)
-            if pinned is None or pinned.status != LIVE:
-                return 200, json.dumps({"closed": False}).encode(), []
-            try:
-                status, data, ra = self._proxy(
-                    pinned, "/decode",
-                    json.dumps({"op": "close", "sid": sid}).encode(),
-                    trace_id)
-                return status, data, [(BACKEND_HEADER, pinned.base_url)]
-            except _HostDown:
-                self._evict(pinned)
-                return 200, json.dumps({"closed": False}).encode(), []
+                hosts = [h for h in self._hosts if h.status == LIVE]
+            if pinned is not None and pinned not in hosts \
+                    and pinned.status == LIVE:
+                hosts.append(pinned)
+            closed = False
+            body = json.dumps({"op": "close", "sid": sid}).encode()
+            served = pinned
+            for h in hosts:
+                try:
+                    status, data, ra = self._proxy(h, "/decode", body,
+                                                   trace_id)
+                    if status == 200 and json.loads(
+                            data.decode() or "{}").get("closed"):
+                        closed = True
+                        served = h
+                except _HostDown:
+                    self._evict(h)
+            backend = [(BACKEND_HEADER, served.base_url)] \
+                if served is not None else []
+            return 200, json.dumps({"closed": closed}).encode(), backend
         # step
         with self._lock:
             history = list(self._history.get(sid) or ())

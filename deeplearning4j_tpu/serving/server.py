@@ -114,6 +114,7 @@ class ModelServer:
         self._thread = None
         self._ledger = None
         self._fleet_collector = None
+        self._decode_collector = None
         self.run_report = None  # goodput RunReport, set by stop()
         self.warmup_s = None    # warm-up ladder wall time, set by start()
         self._is_graph = hasattr(net, "conf") and hasattr(
@@ -558,6 +559,7 @@ class ModelServer:
                     "compute_dtype": self.serving_compute_dtype},
             shapes_fn=lambda: self.shapes_seen)
         self._attach_fleet_collector()
+        self._attach_decode_collector()
         self._ledger = _goodput.start_run("serving", net=self.net)
         self._ledger.rebase_compile(compile0)
         if self.warmup_s is not None:
@@ -639,6 +641,25 @@ class ModelServer:
         reg.register_collector(_collect)
         self._fleet_collector = (reg, _collect)
 
+    def _attach_decode_collector(self):
+        """Decode/KV-pool gauges (shared pages, dedup ratio, chunked
+        prefills) on the unified registry — present only when a decode
+        engine rides this server. ``export_snapshot`` reads the same
+        registry, so these series reach the federation wire form with
+        no extra plumbing."""
+        if self.decode_engine is None:
+            return
+        from deeplearning4j_tpu.serving.metrics import decode_metric_families
+        addr = f"{self.host}:{self.port}"
+
+        def _collect():
+            return decode_metric_families(self.decode_engine.describe(),
+                                          {"server": addr})
+
+        reg = _obs_metrics.get_registry()
+        reg.register_collector(_collect)
+        self._decode_collector = (reg, _collect)
+
     def stop(self):
         """Stop accepting, then drain: every accepted ticket completes
         before the device thread exits. Closes the serving goodput
@@ -658,6 +679,10 @@ class ModelServer:
             reg, collect = self._fleet_collector
             reg.unregister_collector(collect)
             self._fleet_collector = None
+        if self._decode_collector is not None:
+            reg, collect = self._decode_collector
+            reg.unregister_collector(collect)
+            self._decode_collector = None
         ledger = getattr(self, "_ledger", None)
         if ledger is not None and self.stats.first_reply_unix is not None:
             # time-to-first-reply from PROCESS start (kernel starttime):

@@ -316,23 +316,33 @@ def _platform() -> str:
 
 # ------------------------------------------------------------ decode bench
 def bench_decode(sessions: int = 12, gen_tokens: int = 24,
-                 replicas: int = 2, n_pages: int = 24,
+                 replicas: int = 2, n_pages: int = 40,
                  page_tokens: int = 16, max_batch: int = 16,
                  batch_window_ms: float = 2.0, vocab: int = 32,
                  width: int = 64, n_layers: int = 2, n_heads: int = 4,
-                 max_cache_len: int = 128) -> dict:
-    """Sessionful decode serving load (config ``transformer``):
-    ``sessions`` concurrent greedy-decode clients over a ``DecodeEngine``
-    fleet, prompts straddling the 8->16 prompt-bucket boundary, with the
-    KV pool sized so LRU evictions (and their re-prefill recoveries)
-    happen DURING the run.
+                 max_cache_len: int = 128, shared_prefix: int = 32,
+                 stagger_s: float = 0.04) -> dict:
+    """Mixed prefill/decode open-arrival load (config ``transformer``,
+    the TRANSFORMER_r02 arm): ``sessions`` greedy-decode clients arrive
+    STAGGERED (``stagger_s`` apart, open arrival — not a closed-loop
+    start gate), so long prompt prefills land while earlier sessions are
+    mid-decode: exactly the head-of-line collision chunked prefill
+    exists to break. Prompt lengths are heavy-tailed (most short, every
+    fourth group 48-64 suffix tokens), every prompt opens with the same
+    ``shared_prefix``-token system prompt, and sessions arrive in small
+    groups asking the SAME prompt (the millions-of-users shape) — the
+    traffic prefix sharing deduplicates.
 
     Every session's generated token stream is checked against a
     sequential ``rnn_time_step`` reference computed beforehand, and one
     session's logits are checked bit-for-bit — so the published
-    tokens/sec is for decoding that provably coalesces, evicts, and
-    recovers without changing a single output (the fixed-extent-cache
-    contract, ops/attention.py)."""
+    inter-token p99 and dedup ratio are for decoding that provably
+    chunks, shares, and coalesces without changing a single output (the
+    fixed-extent-cache contract, ops/attention.py). The receipt also
+    carries the post-warm compile delta: the chunk ladder must add no
+    fresh compiles during the timed run."""
+    from deeplearning4j_tpu.observability.metrics import (compile_delta,
+                                                          compile_snapshot)
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.zoo import F32, gpt_mini
 
@@ -340,8 +350,20 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
                    n_heads=n_heads, max_len=max_cache_len,
                    max_cache_len=max_cache_len, dtype=F32)
     rng = np.random.default_rng(0)
-    prompts = [[int(t) for t in rng.integers(0, vocab, int(n))]
-               for n in rng.integers(5, 21, sessions)]
+    # shared system prompt + per-group suffix; 3-ish sessions per group
+    prefix = [int(t) for t in rng.integers(0, vocab, shared_prefix)]
+    n_groups = max(2, sessions // 3)
+    suffix_lens = [int(rng.integers(4, 16)) for _ in range(n_groups)]
+    for g in range(0, n_groups, 3):
+        suffix_lens[g] = int(rng.integers(48, 65))   # the heavy tail
+    group_prompts = [
+        prefix + [int(t) for t in rng.integers(0, vocab, n)]
+        for n in suffix_lens]
+    # arrival order starts on a SHORT group so the heavy-tail prompts
+    # land while earlier sessions are mid-decode — the head-of-line
+    # collision this arm exists to measure
+    gid = [(i + 1) % n_groups for i in range(sessions)]
+    prompts = [group_prompts[g] for g in gid]
 
     def oh(ids):
         xx = np.zeros((1, len(ids), vocab), np.float32)
@@ -358,7 +380,8 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
             o = np.asarray(net.rnn_time_step(oh([nxt])))[0, 0]
         return seq
 
-    refs = [ref_generate(ids) for ids in prompts]
+    group_refs = [ref_generate(ids) for ids in group_prompts]
+    refs = [group_refs[g] for g in gid]
 
     eng = DecodeEngine(net, replicas=replicas, n_pages=n_pages,
                        page_tokens=page_tokens, max_batch=max_batch,
@@ -378,6 +401,8 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
     logits_exact &= bool(np.array_equal(ref_l2, eng.step("check", tok)))
     eng.close_session("check")
     net.rnn_clear_previous_state()
+    snap = compile_snapshot()
+    pre = eng.describe()   # so the spot check doesn't pollute run counters
 
     results: list = [None] * sessions
     step_times: list[float] = []
@@ -389,6 +414,7 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
         ts: list[float] = []
         try:
             gate.wait()
+            time.sleep(stagger_s * i)   # open arrival: staggered starts
             out = eng.generate(f"s{i}", prompts[i], gen_tokens,
                                step_times=ts)
             with lock:
@@ -410,6 +436,7 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
         t.join(timeout=600.0)
     wall = time.perf_counter() - t0
     desc = eng.describe()
+    cdelta = compile_delta(snap)
     eng.stop()
     if errors:
         return {"config": "transformer", "error": errors[0]}
@@ -430,6 +457,9 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
         "sessions": sessions, "gen_tokens": gen_tokens,
         "replicas": replicas,
         "prompt_lens": sorted(len(p) for p in prompts),
+        "prompt_groups": n_groups,
+        "shared_prefix_tokens": shared_prefix,
+        "arrival_stagger_s": stagger_s,
         "warmup_s": round(warmup_s, 3),
         "wall_s": round(wall, 3),
         "decode_tokens_per_sec": round(sessions * gen_tokens / wall, 1),
@@ -445,6 +475,27 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
         "kv_evictions": desc["evictions"],
         "reprefills": desc["reprefills"],
         "decode_steps": desc["decode_steps"],
+        # -- chunked prefill + prefix sharing (the r02 arm's raison d'etre);
+        #    counters are run-deltas so the warm-up spot check stays out
+        "prefill_chunk_tokens": desc["prefill_chunk_tokens"],
+        "prefill_chunks": desc["prefill_chunks"] - pre["prefill_chunks"],
+        "chunked_prefills":
+            desc["chunked_prefills"] - pre["chunked_prefills"],
+        "interleaved_prefills":
+            desc["interleaved_prefills"] - pre["interleaved_prefills"],
+        "chunk_interleave_ratio":
+            round((desc["interleaved_prefills"]
+                   - pre["interleaved_prefills"])
+                  / (desc["chunked_prefills"] - pre["chunked_prefills"]), 4)
+            if desc["chunked_prefills"] > pre["chunked_prefills"] else None,
+        "prefix_hits": desc["prefix_hits"] - pre["prefix_hits"],
+        "shared_prompt_tokens":
+            desc["shared_tokens"] - pre["shared_tokens"],
+        "kv_shared_pages": desc["shared_pages"],
+        "kv_store_pages": desc["store_pages"],
+        "kv_logical_pages": desc["logical_pages"],
+        "pool_dedup_ratio": desc["dedup_ratio"],
+        "compile_delta_after_warm": cdelta["count"],
         "affinity_hit_rate": round(hits / (hits + misses), 4)
         if hits + misses else None,
     }
@@ -619,9 +670,11 @@ def main():
     ap.add_argument("--mesh", action="store_true",
                     help="only the tensor-parallel bit-identity serve")
     ap.add_argument("--decode", action="store_true",
-                    help="sessionful KV-cache decode load over the "
-                         "DecodeEngine fleet (config transformer; the "
-                         "TRANSFORMER_r01.json receipt, gated by "
+                    help="mixed prefill/decode open-arrival load over the "
+                         "DecodeEngine fleet: heavy-tailed prompts, shared "
+                         "system prefix, chunked prefill + COW prefix "
+                         "sharing on (config transformer; the "
+                         "TRANSFORMER_r02.json receipt, gated by "
                          "check_budgets)")
     ap.add_argument("--sessions", type=int, default=12,
                     help="concurrent decode sessions (--decode)")

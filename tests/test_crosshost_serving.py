@@ -387,6 +387,75 @@ def test_decode_failover_bit_identical_reprefill_on_survivor():
                 pass
 
 
+def test_decode_failover_with_shared_pages_and_fleetwide_close():
+    """The PR 16 sharing interaction with failover: two sessions carry
+    the SAME prompt, so whichever host serves both shares their prefix
+    pages (refcounted). Killing a pinned host mid-stream must still
+    recover bit-identically — the survivor re-prefills from the
+    router-held history and re-adopts whatever pages its peer already
+    published there — and the router's broadcast close must release
+    every session's pool pages on every live host."""
+    servers = [ModelServer(_tiny_gpt(), port=0, replicas=1, warmup=False,
+                           decode_engine=DecodeEngine(
+                               _tiny_gpt(), n_pages=16, page_tokens=8)
+                           ).start() for _ in range(2)]
+    router = FrontDoorRouter().start()
+    for s in servers:
+        router.add_host(s.url)
+    prompt, n_tokens = [1, 4, 7, 2, 9, 5, 11, 3, 8, 6], 6
+    ref = _ref_stream(prompt, n_tokens)
+    sids = ["sh1", "sh2"]
+    try:
+        logits = {}
+        for sid in sids:
+            st, out, _ = _post(router.url, "/decode",
+                               {"op": "prefill", "sid": sid,
+                                "ids": prompt})
+            assert st == 200
+            logits[sid] = np.asarray(out["logits"], np.float32)
+        toks = {sid: [] for sid in sids}
+        killed = None
+        for i in range(n_tokens):
+            for sid in sids:
+                nxt = int(np.argmax(logits[sid]))
+                toks[sid].append(nxt)
+                st, out, _ = _post(router.url, "/decode",
+                                   {"op": "step", "sid": sid,
+                                    "token": nxt})
+                assert st == 200
+                logits[sid] = np.asarray(out["logits"], np.float32)
+            if i == 1:
+                pinned = router._affinity[sids[0]]
+                killed = next(s for s in servers
+                              if s.url == pinned.base_url)
+                killed.stop()
+                pinned.close()
+        for sid in sids:
+            assert toks[sid] == ref, sid
+        assert router.describe()["failovers_total"] >= 1
+        survivor = next(s for s in servers if s is not killed)
+        # the survivor shared the identical sessions' pages: both ran
+        # there after the kill, with one prompt-page chain between them
+        d = survivor.metrics()["decode"]
+        assert d["sessions_live"] == 2 and d["shared_pages"] >= 1
+        assert d["dedup_ratio"] > 1.0
+        for sid in sids:
+            st, out, _ = _post(router.url, "/decode",
+                               {"op": "close", "sid": sid})
+            assert st == 200 and out["closed"] is True
+        # fleet-wide release: no sessions, no pages, empty shared store
+        d = survivor.metrics()["decode"]
+        assert d["sessions_live"] == 0 and d["pages_used"] == 0
+        assert d["store_pages"] == 0
+    finally:
+        router.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
 def test_decode_step_unknown_session_404_and_bad_op_400():
     router = FrontDoorRouter().start()
     try:
