@@ -19,6 +19,8 @@ from deeplearning4j_tpu.serving.decode import (DecodeEngine, DecodeSession,
 from deeplearning4j_tpu.serving.fleet import Replica, ReplicaSet
 from deeplearning4j_tpu.serving.kvcache import CachePoolFullError, KVPagePool
 from deeplearning4j_tpu.serving.metrics import ServingStats
+from deeplearning4j_tpu.serving.publish import (Publication, WeightStore,
+                                                load_net)
 from deeplearning4j_tpu.serving.router import (FrontDoorRouter, HostHandle,
                                                NoHostsError)
 from deeplearning4j_tpu.serving.server import (DeadlineExceededError,
@@ -28,4 +30,5 @@ __all__ = ["ModelServer", "serve", "MicroBatcher", "QueueFullError",
            "BatcherDeadError", "DeadlineExceededError", "ServingStats",
            "Replica", "ReplicaSet", "DecodeEngine", "DecodeSession",
            "StreamingKVForward", "KVPagePool", "CachePoolFullError",
-           "FrontDoorRouter", "HostHandle", "NoHostsError"]
+           "FrontDoorRouter", "HostHandle", "NoHostsError",
+           "WeightStore", "Publication", "load_net"]

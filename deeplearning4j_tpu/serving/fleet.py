@@ -224,9 +224,20 @@ class ReplicaSet:
         same forward. The forward's jit cache survives the old device
         thread, so the restarted replica serves warm — no second
         bucket-ladder compile (``shapes_seen`` is shared and unchanged).
-        """
+
+        Guarded: restarting a replica that is still LIVE and healthy is
+        an explicit error (``drain(index)`` first, or use
+        :meth:`swap_forward` for a zero-blackout in-place swap) — the
+        old behavior silently stacked a second batcher over a running
+        one, leaking its device thread and queue."""
         r = self.replicas[index]
         old = r.batcher
+        with self._lock:
+            if r.status == LIVE and old.healthy:
+                raise RuntimeError(
+                    f"replica {index} is live and healthy — drain({index}) "
+                    "before restart, or swap_forward() for an in-place "
+                    "hot swap")
         if old.healthy:
             old.stop()
         fresh = self._make_batcher(old._forward).start()
@@ -240,6 +251,36 @@ class ReplicaSet:
             # _make_batcher rebound the shared stats' depth fn to the
             # new batcher's queue; restore the fleet-wide total
             self.stats.queue_depth_fn = self.total_depth
+        return r
+
+    def swap_forward(self, index: int, forward):
+        """Zero-blackout hot swap: replace one replica's forward with a
+        FRESH batcher over *forward*, publish-then-drain. The new
+        batcher is built and started first, then published under the
+        lock (a concurrent ``_pick`` sees either the old live batcher
+        or the new live batcher — never a gap), and only THEN does the
+        old batcher drain gracefully: its accepted queue and in-flight
+        batch finish on the OLD forward (old weights) while new
+        admissions already run the new one. The drain blocks the swap
+        *caller*, never traffic.
+
+        When both forwards close over the same jitted programs (the
+        ``ModelServer.hot_swap`` version-bound closures share the
+        serving net's jit cache), the swap compiles nothing fresh —
+        ``shapes_seen`` is shared and unchanged."""
+        r = self.replicas[index]
+        fresh = self._make_batcher(forward).start()
+        with self._lock:
+            old = r.batcher
+            r.batcher = fresh
+            r.status = LIVE
+            r.evicted_at = None
+        if self.stats is not None:
+            # _make_batcher rebound the shared stats' depth fn to the
+            # new batcher's queue; restore the fleet-wide total
+            self.stats.queue_depth_fn = self.total_depth
+        if old.healthy:
+            old.stop()   # graceful: queued tickets finish on old weights
         return r
 
     def restart_fleet(self, forwards=None, *, n: Optional[int] = None,
@@ -333,14 +374,26 @@ class ReplicaSet:
                     raise err
                 outer.set_exception(err)
                 return
+            b = r.batcher
             try:
-                inner = r.batcher.submit(feats, trace_id)
+                inner = b.submit(feats, trace_id)
             except BatcherDeadError:
                 # lost the race with a dying device thread — evict and
                 # try the next live replica
                 self._mark_dead(r)
                 continue
-            except (QueueFullError, RuntimeError):
+            except QueueFullError:
+                if first:
+                    raise
+                outer.set_exception(
+                    QueueFullError("no capacity on surviving replicas"))
+                return
+            except RuntimeError:
+                if r.batcher is not b:
+                    # lost the race with a hot swap: the stopped batcher
+                    # we captured was already replaced — the replica is
+                    # live again under its fresh batcher, re-pick
+                    continue
                 if first:
                     raise
                 # requeue path hit a full/stopped survivor: the client

@@ -48,6 +48,7 @@ class ServingStats:
         self.rejected = 0                   # 503 admission rejections
         self.errors = 0                     # 400 request failures
         self.timeouts = 0                   # 504 per-request deadline expiries
+        self.nan_rows = 0                   # replies with non-finite values
         self.batch_hist: dict[int, int] = {}  # executed bucket -> count
         # executed bucket -> cumulative device-forward seconds: the
         # measured per-bucket service times the trace autotuner fits
@@ -138,6 +139,14 @@ class ServingStats:
         with self._lock:
             self.timeouts += 1
 
+    def record_nan_rows(self, n: int = 1):
+        """Rows whose reply carried a non-finite value — the serving
+        twin of the supervisor's NaN sentinel, and a canary promotion
+        gate (a freshly published version that starts emitting NaNs is
+        rolled back before it leaves its traffic fraction)."""
+        with self._lock:
+            self.nan_rows += int(n)
+
     # ------------------------------------------------------------- reporting
     def _percentiles(self, lats, qs):
         if not lats:
@@ -163,6 +172,7 @@ class ServingStats:
                 "rejected_total": self.rejected,
                 "errors_total": self.errors,
                 "timeouts_total": self.timeouts,
+                "nan_rows_total": self.nan_rows,
                 "queue_depth": int(self.queue_depth_fn()),
                 "latency_ms": self._percentiles(lats, (0.50, 0.95, 0.99)),
                 "latency_window": n,
@@ -225,6 +235,9 @@ class ServingStats:
             "Request failures", snap["errors_total"])
         fam("dl4j_serving_timeouts_total", "counter",
             "504 per-request deadline expiries", snap["timeouts_total"])
+        fam("dl4j_serving_nan_rows_total", "counter",
+            "Reply rows carrying non-finite values (the serving NaN "
+            "sentinel — a canary promotion gate)", snap["nan_rows_total"])
         fam("dl4j_serving_queue_depth", "gauge",
             "Tickets pending in the micro-batch queue",
             snap["queue_depth"])

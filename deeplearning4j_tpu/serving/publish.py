@@ -1,0 +1,319 @@
+"""Versioned weight publication: the train-to-serve live-reload seam.
+
+The resilience supervisor (resilience/supervisor.py) writes
+``step_<n>`` checkpoints plus an atomic ``LATEST`` pointer for *resume*;
+this module promotes those checkpoints into a **publication store** for
+*serving* — the TF-paper versioned-model story (PAPERS.md): a training
+run publishes, a serving fleet hot-swaps onto the newest publication,
+a canary that fails its gates is rolled back by repointing, never by
+rewriting weights.
+
+Store layout (``root/``)::
+
+    v_000001/            one published version = one complete checkpoint
+      tree/              orbax param/state/opt trees (copied verbatim)
+      meta.json          the checkpoint's own metadata
+      layout.json        schema-v2 layout manifest (when the save had one)
+      publication.json   {version, fingerprint, source, status, ...}
+    v_000002/
+    LATEST               atomic pointer -> the version serving should run
+
+Discipline mirrors the checkpoint machinery it feeds from:
+
+- **Atomic landing.** A publication is staged under a dot-temp dir and
+  ``os.replace``d into its ``v_%06d`` name — a reader never sees a
+  half-copied version. ``LATEST`` lands the same way (tmp + rename),
+  exactly the supervisor's pointer idiom.
+- **Monotonic versions.** Version numbers only grow; a rollback moves
+  the LATEST pointer *backwards across* versions, it never renumbers.
+- **Fingerprint stamping.** Every publication records the PR 10
+  ``compilecache.manifest.model_fingerprint`` of a structure-only net
+  built from the checkpoint's own config — the compatibility key
+  ``ModelServer.hot_swap`` checks before binding the weights to the
+  live jit cache (same fingerprint ⇒ same param pytree structure ⇒
+  the already-compiled bucket executables serve the new weights with
+  0 fresh compiles).
+- **Rollback as a verb.** ``rollback()`` marks the current LATEST
+  version ``rejected`` (publication.json rewritten atomically, with the
+  reason) and repoints LATEST at the newest non-rejected predecessor.
+  Rejected versions are never candidates for LATEST again, but their
+  bits stay on disk until retention GC ages them out — a post-mortem
+  can still load exactly what was rolled back.
+- **Retention.** ``keep`` newest versions survive GC; the LATEST target
+  is never deleted regardless of age.
+
+See SERVING.md §Live reload; receipts: scripts/chaos_livereload.py ->
+LIVERELOAD_r01.json, gated by BUDGETS.json ``live_reload``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+from typing import List, Optional
+
+from deeplearning4j_tpu.utils.checkpoint import (find_latest_checkpoint,
+                                                 is_valid_checkpoint,
+                                                 read_checkpoint_meta)
+
+__all__ = ["WeightStore", "Publication", "load_net"]
+
+_VER_DIR = re.compile(r"^v_(\d{6})$")
+_LATEST = "LATEST"
+PUBLICATION_META = "publication.json"
+
+
+class Publication:
+    """One published version: a complete checkpoint directory plus its
+    ``publication.json`` stamp. Restorable directly — ``path`` is a
+    valid checkpoint path for ``restore_*`` / :func:`load_net`."""
+
+    __slots__ = ("version", "path", "meta")
+
+    def __init__(self, version: int, path: str, meta: dict):
+        self.version = int(version)
+        self.path = path
+        self.meta = meta
+
+    @property
+    def fingerprint(self) -> Optional[str]:
+        return self.meta.get("fingerprint")
+
+    @property
+    def status(self) -> str:
+        return self.meta.get("status", "published")
+
+    @property
+    def rejected(self) -> bool:
+        return self.status == "rejected"
+
+    def describe(self) -> dict:
+        return {"version": self.version, "path": self.path, **self.meta}
+
+    def __repr__(self):
+        return (f"Publication(v{self.version}, {self.status}, "
+                f"fp={self.fingerprint})")
+
+
+def load_net(path: str, mesh=None, **restore_kw):
+    """Restore the net a publication (or any checkpoint directory)
+    holds, dispatching on the checkpoint's own ``kind``. Single-device
+    restore places leaves directly (no compiler involvement), so a
+    reload's cost is I/O, not XLA. Single-device leaves are then
+    round-tripped through host memory to shed the restore's *committed*
+    device placement — jit keys on committedness, so without this a
+    server booted from a publication and later hot-swapped would pay
+    one retrace per swap instead of hitting its warm cache."""
+    from deeplearning4j_tpu.utils.checkpoint import (
+        restore_computation_graph, restore_multi_layer_network)
+    kind = read_checkpoint_meta(path)["kind"]
+    fn = (restore_computation_graph if kind == "graph"
+          else restore_multi_layer_network)
+    net = fn(path, mesh=mesh, **restore_kw)
+    if mesh is None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def _uncommit(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(a)), tree)
+        net.params = _uncommit(net.params)
+        if net.state:
+            net.state = _uncommit(net.state)
+    return net
+
+
+def _fingerprint_of_checkpoint(path: str) -> str:
+    """The PR 10 model fingerprint of the checkpoint's config: built
+    from a structure-only net (no parameter materialization), so
+    publishing is cheap even for big models."""
+    from deeplearning4j_tpu.compilecache.manifest import model_fingerprint
+    meta = read_checkpoint_meta(path)
+    if meta["kind"] == "graph":
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = ComputationGraphConfiguration.from_json(meta["config"])
+        net = ComputationGraph(conf).init(structure_only=True)
+    else:
+        from deeplearning4j_tpu.nn.conf.core import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = MultiLayerConfiguration.from_json(meta["config"])
+        net = MultiLayerNetwork(conf).init(structure_only=True)
+    return model_fingerprint(net)
+
+
+class WeightStore:
+    """The versioned publication store (module docstring has the
+    layout + discipline). Safe for one publisher process; readers
+    (serving hosts, orchestrators) may poll concurrently — every state
+    change lands via rename."""
+
+    def __init__(self, root: str, *, keep: int = 3):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.root = os.path.abspath(root)
+        self.keep = int(keep)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------- reading
+    def versions(self, include_rejected: bool = True) -> List[Publication]:
+        """All publications, oldest first. Staged temp dirs and corpses
+        GC'd mid-scan are skipped (the find_latest_checkpoint race
+        stance)."""
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            m = _VER_DIR.match(name)
+            if m is None:
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                with open(os.path.join(path, PUBLICATION_META)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-publish or mid-GC — not a version yet/anymore
+            pub = Publication(int(m.group(1)), path, meta)
+            if include_rejected or not pub.rejected:
+                out.append(pub)
+        return out
+
+    def get(self, version: int) -> Publication:
+        path = os.path.join(self.root, f"v_{int(version):06d}")
+        with open(os.path.join(path, PUBLICATION_META)) as f:
+            return Publication(version, path, json.load(f))
+
+    def latest(self) -> Optional[Publication]:
+        """The publication the LATEST pointer names, or None for an
+        empty store."""
+        try:
+            with open(os.path.join(self.root, _LATEST)) as f:
+                name = f.read().strip()
+        except FileNotFoundError:
+            return None
+        m = _VER_DIR.match(name)
+        if m is None:
+            return None
+        try:
+            return self.get(int(m.group(1)))
+        except (OSError, ValueError):
+            return None
+
+    # ----------------------------------------------------------- publishing
+    def _write_latest(self, version: int) -> None:
+        # the supervisor's pointer idiom: tmp in the same dir + rename
+        tmp = os.path.join(self.root, "." + _LATEST + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(f"v_{int(version):06d}")
+        os.replace(tmp, os.path.join(self.root, _LATEST))
+
+    def _write_publication_meta(self, path: str, meta: dict) -> None:
+        tmp = os.path.join(path, "." + PUBLICATION_META + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(path, PUBLICATION_META))
+
+    def publish(self, checkpoint_path: str, *, source: Optional[str] = None,
+                extra: Optional[dict] = None) -> Publication:
+        """Promote one complete checkpoint into the next version:
+        copy, stamp, land atomically, repoint LATEST, GC retention.
+        Returns the new :class:`Publication` (now == ``latest()``)."""
+        checkpoint_path = os.path.abspath(checkpoint_path)
+        if not is_valid_checkpoint(checkpoint_path):
+            raise ValueError(
+                f"not a complete checkpoint: {checkpoint_path} (needs the "
+                "orbax tree dir AND meta.json — partial saves are not "
+                "publishable)")
+        fingerprint = _fingerprint_of_checkpoint(checkpoint_path)
+        ckpt_meta = read_checkpoint_meta(checkpoint_path)
+        prev = self.versions()
+        version = (prev[-1].version + 1) if prev else 1
+        name = f"v_{version:06d}"
+        final = os.path.join(self.root, name)
+        staged = os.path.join(self.root, f".{name}.tmp-{os.getpid()}")
+        if os.path.isdir(staged):
+            shutil.rmtree(staged)
+        shutil.copytree(checkpoint_path, staged)
+        meta = {
+            "schema": 1,
+            "version": version,
+            "fingerprint": fingerprint,
+            "source": source if source is not None else checkpoint_path,
+            "published_unix": time.time(),
+            "status": "published",
+            "iteration": ckpt_meta.get("iteration"),
+            "epoch": ckpt_meta.get("epoch"),
+            "kind": ckpt_meta.get("kind"),
+        }
+        if extra:
+            for k in extra:
+                if k in meta:
+                    raise ValueError(f"extra key {k!r} shadows a "
+                                     "publication field")
+            meta.update(extra)
+        self._write_publication_meta(staged, meta)
+        os.replace(staged, final)          # the version exists, atomically
+        self._write_latest(version)
+        self._gc()
+        return Publication(version, final, meta)
+
+    def publish_latest(self, checkpoint_dir: str, **kw) -> Publication:
+        """Promote the newest *valid* ``step_<n>`` checkpoint under a
+        supervisor checkpoint directory (``resilient_fit``'s
+        ``checkpoint_dir``) — the one-call train→publish bridge."""
+        ckpt = find_latest_checkpoint(checkpoint_dir)
+        if ckpt is None:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {checkpoint_dir}")
+        return self.publish(ckpt, **kw)
+
+    # ------------------------------------------------------------- rollback
+    def rollback(self, reason: str = "") -> Publication:
+        """The verb: mark the current LATEST version ``rejected`` (with
+        the reason, for the post-mortem) and repoint LATEST at the
+        newest non-rejected predecessor. Returns the publication LATEST
+        now names. Raises RuntimeError when no good predecessor exists —
+        a fleet must not silently keep serving a version its gates just
+        killed."""
+        cur = self.latest()
+        if cur is None:
+            raise RuntimeError("empty store: nothing to roll back")
+        meta = dict(cur.meta)
+        meta["status"] = "rejected"
+        meta["rejected_unix"] = time.time()
+        meta["rejected_reason"] = reason
+        self._write_publication_meta(cur.path, meta)
+        good = [p for p in self.versions(include_rejected=False)
+                if p.version < cur.version]
+        if not good:
+            raise RuntimeError(
+                f"v{cur.version} rejected but no earlier non-rejected "
+                "version exists to roll back to")
+        self._write_latest(good[-1].version)
+        return good[-1]
+
+    # ------------------------------------------------------------ retention
+    def _gc(self) -> None:
+        """Keep the newest ``keep`` versions plus whatever LATEST names
+        (a rollback target older than the window must survive)."""
+        pubs = self.versions()
+        if len(pubs) <= self.keep:
+            return
+        latest = self.latest()
+        protect = {p.version for p in pubs[-self.keep:]}
+        if latest is not None:
+            protect.add(latest.version)
+        for p in pubs:
+            if p.version not in protect:
+                shutil.rmtree(p.path, ignore_errors=True)
+
+    def describe(self) -> dict:
+        latest = self.latest()
+        return {
+            "root": self.root,
+            "latest_version": latest.version if latest else None,
+            "versions": [p.describe() for p in self.versions()],
+        }

@@ -42,6 +42,29 @@ plane.
   federation scoreboard plus the live routing table, and a router
   given ``push_url`` pushes its own snapshot (routing table in the
   health payload) to a dashboard UIServer, which renders it.
+- **Heartbeat auto-eviction.** A host that stops pushing is first
+  skipped (stale, past ``stale_after_s``) and then EVICTED once its
+  silence exceeds ``evict_after_factor × stale_after_s`` — mirroring
+  ``MetricsFederation.health()``'s own auto-evict, so the routing
+  table and the scoreboard forget a dead host on the same clock. A
+  host that never pushed at all stays trusted (the metrics plane is a
+  routing signal, not an admission gate).
+- **Canary routing + rollback as a verb** (SERVING.md §Live reload).
+  ``start_canary(url, version=...)`` pins a traffic *fraction* to one
+  canary-version host via a token bucket (the canary can never exceed
+  its fraction — containment is structural, not statistical), keeps
+  it out of stable routing and decode pinning, and snapshots a
+  baseline of the fleet's pushed serving counters.
+  ``evaluate_canary()`` differences live federation metrics against
+  that baseline — error-rate delta, NaN-sentinel rows, p99 ratio vs
+  the stable hosts — and answers pass / fail(+killing gate) / wait.
+  ``promote_canary()`` admits the host to stable routing;
+  ``rollback_canary()`` quarantines it (it still holds the bad
+  weights), drops its decode pins so sessions fail over by
+  re-prefill, and flushes a flight-recorder artifact (reason
+  ``"rollback"``) naming the rejected version and the metric delta
+  that killed it. ``reinstate(url)`` lifts the quarantine after the
+  host has been swapped back to good weights.
 
 The router never imports jax — it is a pure dispatch process, cheap
 enough to front accelerator hosts without stealing their cores.
@@ -103,6 +126,11 @@ class HostHandle:
         self.in_flight = 0
         self.picks = 0
         self.errors = 0
+        #: unix time of the host's last observed federation push —
+        #: derived from pushed heartbeat age, so it survives the
+        #: federation's own auto-evict dropping the row (the router
+        #: still knows how long this host has been silent)
+        self.last_push_unix: Optional[float] = None
         self._lock = threading.Lock()
         self._idle: List[http.client.HTTPConnection] = []
 
@@ -153,7 +181,9 @@ class HostHandle:
 @guarded_by("_lock", "_hosts", "_rr", "_affinity", "_history",
             "requests_total", "decode_steps_total", "retried_total",
             "evicted_total", "failovers_total", "affinity_hits",
-            "affinity_misses", "shed_total")
+            "affinity_misses", "shed_total", "auto_evicted_total",
+            "rollbacks_total", "promotions_total", "_quarantined",
+            "_canary", "_canary_credit", "canary_routed_total")
 class FrontDoorRouter:
     """The front door: an HTTP server federating N backend
     ``ModelServer`` hosts.
@@ -168,6 +198,7 @@ class FrontDoorRouter:
 
     def __init__(self, hosts=(), host: str = "127.0.0.1", port: int = 0,
                  *, stale_after_s: float = 10.0,
+                 evict_after_factor: Optional[float] = 4.0,
                  request_timeout_s: float = 120.0,
                  federation: Optional[MetricsFederation] = None,
                  push_url: Optional[str] = None,
@@ -177,6 +208,16 @@ class FrontDoorRouter:
         self.request_timeout_s = float(request_timeout_s)
         self.federation = federation if federation is not None else \
             MetricsFederation(stale_after_s=stale_after_s)
+        #: auto-eviction threshold as a multiple of the federation's
+        #: ``stale_after_s`` (mirrors MetricsFederation.health); None
+        #: disables — stale hosts are then only skipped, never evicted
+        self.evict_after_factor = (None if evict_after_factor is None
+                                   else float(evict_after_factor))
+        if self.evict_after_factor is not None \
+                and self.evict_after_factor < 1.0:
+            raise ValueError("evict_after_factor must be >= 1 (eviction "
+                             "below the stale bound would drop hosts the "
+                             "router still routes to)")
         self._hosts: List[HostHandle] = []
         self._lock = threading.Lock()
         self._rr = 0                       # round-robin tiebreak cursor
@@ -193,6 +234,18 @@ class FrontDoorRouter:
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.shed_total = 0               # global-backpressure 503s
+        self.auto_evicted_total = 0       # heartbeat-silence evictions
+        # ---- canary state (SERVING.md §Live reload) ----
+        self._canary: Optional[dict] = None
+        self._canary_credit = 0.0         # token bucket: += fraction/request
+        self.canary_routed_total = 0
+        self.rollbacks_total = 0
+        self.promotions_total = 0
+        #: hosts rolled back while still holding rejected weights — out
+        #: of ALL routing until reinstate()
+        self._quarantined: set = set()
+        self.last_rollback_artifact: Optional[str] = None
+        self._registry_collector = None
         self._httpd = None
         self._thread = None
         self._pusher: Optional[HeartbeatPusher] = None
@@ -233,17 +286,51 @@ class FrontDoorRouter:
             self.evicted_total += 1
         h.close()
 
+    def _auto_evict(self, h: HostHandle) -> None:
+        """Heartbeat-silence eviction (the MetricsFederation.health
+        mirror): the host stops being a routing candidate permanently —
+        a resurrected process rejoins via add_host, with fresh state."""
+        with self._lock:
+            if h.status == DEAD:
+                return
+            h.status = DEAD
+            self.evicted_total += 1
+            self.auto_evicted_total += 1
+        h.close()
+
     # --------------------------------------------------------------- routing
     def _routable(self, exclude=()) -> List[HostHandle]:
-        """Hosts new work may go to: not evicted, not heartbeat-stale
-        (a host that has never pushed is trusted — the metrics plane is
-        a routing signal, not an admission gate)."""
+        """Hosts new STABLE work may go to: not evicted, not
+        quarantined (rolled-back canary weights), not the active canary
+        host (it only receives its token-bucket fraction), not
+        heartbeat-stale — and hosts silent past ``evict_after_factor ×
+        stale_after_s`` are auto-evicted here, on the routing path, the
+        same place staleness is already observed. A host that has never
+        pushed is trusted (the metrics plane is a routing signal, not
+        an admission gate)."""
         fed = self._fed_rows()
+        now = time.time()
+        with self._lock:
+            canary_host = self._canary["host"] if self._canary else None
+            quarantined = set(self._quarantined)
         out = []
         for h in self.hosts:
             if h.status != LIVE or h in exclude:
                 continue
             row = fed.get(h.base_url)
+            if row is not None:
+                # stamp observed push recency so the silence clock keeps
+                # running even after the federation drops the row
+                h.last_push_unix = now - float(row["heartbeat_age_s"])
+            if self.evict_after_factor is not None \
+                    and h.last_push_unix is not None \
+                    and (now - h.last_push_unix
+                         > self.evict_after_factor
+                         * self.federation.stale_after_s):
+                self._auto_evict(h)
+                continue
+            if h in quarantined or h is canary_host:
+                continue
             if row is not None and not row["live"]:
                 continue
             out.append((h, row))
@@ -299,6 +386,221 @@ class FrontDoorRouter:
             if ra is not None:
                 vals.append(float(ra))
         return min(vals) if vals else _RETRY_AFTER_FLOOR_S
+
+    # ---------------------------------------------------------------- canary
+    def _serving_counters(self, url: str) -> Optional[dict]:
+        """The host's pushed canary-gate slice (``health["serving"]``
+        from ModelServer._push_health), or None before its first push."""
+        row = self._fed_rows().get(url.rstrip("/"))
+        if row is None:
+            return None
+        return (row.get("health") or {}).get("serving")
+
+    def start_canary(self, base_url: str, *, version=None,
+                     fraction: float = 0.1,
+                     max_error_rate_delta: float = 0.02,
+                     max_nan_rows: int = 0,
+                     max_p99_ratio: float = 3.0,
+                     min_requests: int = 20) -> dict:
+        """Begin canarying one host: it leaves stable routing and
+        receives exactly ``fraction`` of /predict traffic via a token
+        bucket (credit accrues per request; the canary is picked only
+        when a whole token is banked, so its share can NEVER exceed the
+        fraction — containment by construction). The host may already
+        be registered (add_host) or is registered here. Baselines for
+        the promotion gates are snapshotted from the live federation
+        plane now; ``evaluate_canary`` differences against them.
+
+        Gates: ``max_error_rate_delta`` (canary errors per canary
+        request above the stable fleet's rate), ``max_nan_rows``
+        (NaN-sentinel rows since baseline — 0 means one poisoned reply
+        kills it), ``max_p99_ratio`` (canary p99 over the stable
+        median), all judged only after ``min_requests`` canary
+        requests."""
+        if not 0.0 < fraction <= 0.5:
+            raise ValueError("canary fraction must be in (0, 0.5] — above "
+                             "half, the 'canary' is the fleet")
+        url = base_url.rstrip("/")
+        h = next((x for x in self.hosts if x.base_url == url), None)
+        if h is None:
+            h = self.add_host(url)
+        with self._lock:
+            if self._canary is not None:
+                raise RuntimeError(
+                    f"canary already active (v{self._canary['version']} on "
+                    f"{self._canary['host'].base_url}) — promote or roll "
+                    "back first")
+            if h in self._quarantined:
+                raise RuntimeError(f"{url} is quarantined (rolled back) — "
+                                   "reinstate() it first")
+        baseline = {"canary": self._serving_counters(url) or {},
+                    "stable": {x.base_url: self._serving_counters(x.base_url)
+                               for x in self.hosts
+                               if x is not h and x.status == LIVE}}
+        canary = {"host": h, "version": version, "fraction": float(fraction),
+                  "gates": {"max_error_rate_delta": float(
+                                max_error_rate_delta),
+                            "max_nan_rows": int(max_nan_rows),
+                            "max_p99_ratio": float(max_p99_ratio),
+                            "min_requests": int(min_requests)},
+                  "baseline": baseline, "started_unix": time.time(),
+                  "routed": 0}
+        with self._lock:
+            self._canary = canary
+            self._canary_credit = 0.0
+        return {"host": url, "version": version, "fraction": fraction}
+
+    def _pick_canary_admitted(self, tried) -> Optional[HostHandle]:
+        """The /predict pick: the canary host when the token bucket has
+        banked a whole token (and the canary is still alive and not yet
+        tried), the stable least-loaded pick otherwise. A canary that
+        fails mid-request lands in ``tried`` and the retry goes stable —
+        the client never pays for the canary's death."""
+        with self._lock:
+            can = self._canary
+            take = False
+            if can is not None and not tried \
+                    and can["host"].status == LIVE:
+                self._canary_credit += can["fraction"]
+                if self._canary_credit >= 1.0:
+                    self._canary_credit -= 1.0
+                    can["routed"] += 1
+                    self.canary_routed_total += 1
+                    take = True
+        if take:
+            return can["host"]
+        return self._pick(exclude=tried)
+
+    def evaluate_canary(self) -> dict:
+        """Judge the active canary against its gates using live
+        federation deltas. Returns a verdict dict: ``decision`` is
+        ``"pass"`` / ``"fail"`` / ``"wait"`` (not enough canary traffic
+        yet, or no push since baseline); on fail, ``killed_by`` names
+        the gate and the measured delta — exactly what the rollback
+        flight record carries."""
+        with self._lock:
+            can = self._canary
+        if can is None:
+            raise RuntimeError("no active canary")
+        gates = can["gates"]
+        url = can["host"].base_url
+        now = self._serving_counters(url)
+        base = can["baseline"]["canary"]
+        verdict = {"version": can["version"], "host": url,
+                   "fraction": can["fraction"], "routed": can["routed"],
+                   "decision": "wait", "killed_by": None, "deltas": {}}
+        if now is None:
+            return verdict  # nothing pushed since the canary booted
+        d_req = (now.get("requests_total") or 0) \
+            - (base.get("requests_total") or 0)
+        d_err = (now.get("errors_total") or 0) \
+            - (base.get("errors_total") or 0)
+        d_nan = (now.get("nan_rows_total") or 0) \
+            - (base.get("nan_rows_total") or 0)
+        verdict["deltas"] = {"requests": d_req, "errors": d_err,
+                             "nan_rows": d_nan}
+        # stable p99 median for the ratio gate, from live pushes
+        stable_p99 = sorted(
+            s["latency_p99_ms"]
+            for s in (self._serving_counters(u)
+                      for u in can["baseline"]["stable"])
+            if s and s.get("latency_p99_ms") is not None)
+        p99 = now.get("latency_p99_ms")
+        if p99 is not None and stable_p99:
+            med = stable_p99[len(stable_p99) // 2]
+            if med > 0:
+                verdict["deltas"]["p99_ratio"] = round(p99 / med, 3)
+        # NaN gate first: a poisoned version must die before min_requests
+        # worth of users see it — one bad reply is already the evidence
+        if d_nan > gates["max_nan_rows"]:
+            verdict.update(decision="fail", killed_by={
+                "gate": "max_nan_rows", "bound": gates["max_nan_rows"],
+                "measured": d_nan})
+            return verdict
+        if d_req < gates["min_requests"]:
+            return verdict
+        err_rate = d_err / d_req if d_req else 0.0
+        if err_rate > gates["max_error_rate_delta"]:
+            verdict.update(decision="fail", killed_by={
+                "gate": "max_error_rate_delta",
+                "bound": gates["max_error_rate_delta"],
+                "measured": round(err_rate, 4)})
+            return verdict
+        ratio = verdict["deltas"].get("p99_ratio")
+        if ratio is not None and ratio > gates["max_p99_ratio"]:
+            verdict.update(decision="fail", killed_by={
+                "gate": "max_p99_ratio", "bound": gates["max_p99_ratio"],
+                "measured": ratio})
+            return verdict
+        verdict["decision"] = "pass"
+        return verdict
+
+    def promote_canary(self) -> dict:
+        """Admit the canary host to stable routing (the token bucket
+        stops; it now competes least-loaded like everyone else).
+        Promotion is the caller's decision — evaluate first; this does
+        not re-judge."""
+        with self._lock:
+            can = self._canary
+            if can is None:
+                raise RuntimeError("no active canary")
+            self._canary = None
+            self._canary_credit = 0.0
+            self.promotions_total += 1
+        return {"promoted": can["host"].base_url,
+                "version": can["version"], "routed": can["routed"]}
+
+    def rollback_canary(self, verdict: Optional[dict] = None,
+                        reason: str = "") -> dict:
+        """The rollback verb, router side: quarantine the canary host
+        (it still HOLDS the rejected weights — it must not rejoin
+        stable routing until reinstate()), drop its decode pins so
+        sessions fail over by history re-prefill, and flush a
+        flight-recorder artifact (reason ``"rollback"``) naming the
+        rejected version and the gate delta that killed it. The weight
+        store's own ``rollback()`` (serving/publish.py) repoints LATEST
+        — the orchestrator calls both, chaos_livereload.py is the
+        receipt."""
+        with self._lock:
+            can = self._canary
+            if can is None:
+                raise RuntimeError("no active canary")
+            h = can["host"]
+            self._canary = None
+            self._canary_credit = 0.0
+            self._quarantined.add(h)
+            self.rollbacks_total += 1
+            dropped = [sid for sid, ph in self._affinity.items() if ph is h]
+            for sid in dropped:
+                del self._affinity[sid]
+        detail = {"rejected_version": can["version"],
+                  "host": h.base_url, "routed": can["routed"],
+                  "fraction": can["fraction"],
+                  "reason": reason or None,
+                  "killed_by": (verdict or {}).get("killed_by"),
+                  "deltas": (verdict or {}).get("deltas")}
+        from deeplearning4j_tpu.observability.flightrec import (
+            get_flight_recorder)
+        rec = get_flight_recorder()
+        if rec is not None:
+            rec.record_event("canary_rollback",
+                             detail=json.dumps(detail, sort_keys=True))
+            self.last_rollback_artifact = rec.flush("rollback")
+        return {"rolled_back": h.base_url, "version": can["version"],
+                "quarantined": True, "sessions_dropped": len(dropped),
+                "artifact": self.last_rollback_artifact, **detail}
+
+    def reinstate(self, base_url: str) -> bool:
+        """Lift a rolled-back host's quarantine — AFTER it has been
+        swapped back to good weights (hot_swap / restart on a good
+        publication). Returns whether anything changed."""
+        url = base_url.rstrip("/")
+        with self._lock:
+            for h in list(self._quarantined):
+                if h.base_url == url:
+                    self._quarantined.discard(h)
+                    return True
+        return False
 
     # ---------------------------------------------------------------- proxy
     def _proxy(self, h: HostHandle, path: str, body: bytes,
@@ -367,7 +669,7 @@ class FrontDoorRouter:
         with self._lock:
             self.requests_total += 1
         return self._route("/predict", body, trace_id,
-                           lambda tried: self._pick(exclude=tried))[:3]
+                           self._pick_canary_admitted)[:3]
 
     def handle_decode(self, payload: dict, trace_id: str) -> tuple:
         """Session-affine proxy for the host /decode protocol. The
@@ -486,17 +788,29 @@ class FrontDoorRouter:
 
     def describe(self) -> dict:
         with self._lock:
+            can = self._canary
             return {
                 "hosts": len(self._hosts),
                 "requests_total": self.requests_total,
                 "decode_steps_total": self.decode_steps_total,
                 "retried_total": self.retried_total,
                 "evicted_total": self.evicted_total,
+                "auto_evicted_total": self.auto_evicted_total,
                 "failovers_total": self.failovers_total,
                 "affinity_hits": self.affinity_hits,
                 "affinity_misses": self.affinity_misses,
                 "shed_total": self.shed_total,
                 "sessions_live": len(self._history),
+                "canary": (None if can is None else {
+                    "host": can["host"].base_url,
+                    "version": can["version"],
+                    "fraction": can["fraction"],
+                    "routed": can["routed"]}),
+                "canary_routed_total": self.canary_routed_total,
+                "rollbacks_total": self.rollbacks_total,
+                "promotions_total": self.promotions_total,
+                "quarantined": sorted(h.base_url
+                                      for h in self._quarantined),
             }
 
     def healthz(self) -> tuple:
@@ -517,6 +831,50 @@ class FrontDoorRouter:
         payload["routing"] = self.route_table()
         payload["router"] = self.describe()
         return payload
+
+    def _attach_registry_collector(self):
+        """The router's own counters as registry families — rendered by
+        its ``/metrics`` exposition via the federation AND carried by
+        its push_url heartbeats (export_snapshot reads the same
+        registry), so a dashboard sees canary/promotion/rollback state
+        with no new endpoints."""
+        from deeplearning4j_tpu.observability.metrics import MetricFamily
+
+        def _collect():
+            d = self.describe()
+            L = {"router": f"{self.host}:{self.port}"}
+            fams = []
+
+            def fam(name, kind, help, value):
+                fams.append(MetricFamily(name, kind, help).add(value, L))
+
+            fam("dl4j_router_requests_total", "counter",
+                "/predict requests through the front door",
+                d["requests_total"])
+            fam("dl4j_router_evicted_total", "counter",
+                "Hosts evicted (connection death + heartbeat silence)",
+                d["evicted_total"])
+            fam("dl4j_router_auto_evicted_total", "counter",
+                "Hosts auto-evicted for heartbeat silence past "
+                "evict_after_factor x stale_after_s",
+                d["auto_evicted_total"])
+            fam("dl4j_router_canary_routed_total", "counter",
+                "Requests token-bucket-admitted to canary hosts",
+                d["canary_routed_total"])
+            fam("dl4j_router_canary_fraction", "gauge",
+                "Active canary traffic fraction (0 = no canary)",
+                (d["canary"] or {}).get("fraction") or 0.0)
+            fam("dl4j_router_promotions_total", "counter",
+                "Canary versions promoted to stable routing",
+                d["promotions_total"])
+            fam("dl4j_router_rollbacks_total", "counter",
+                "Canary versions rolled back by their gates",
+                d["rollbacks_total"])
+            return fams
+
+        reg = _obs_metrics.get_registry()
+        reg.register_collector(_collect)
+        self._registry_collector = (reg, _collect)
 
     # ---------------------------------------------------------------- server
     def start(self) -> "FrontDoorRouter":
@@ -601,6 +959,7 @@ class FrontDoorRouter:
 
         self._httpd = _RouterHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        self._attach_registry_collector()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -624,6 +983,10 @@ class FrontDoorRouter:
         if self._pusher is not None:
             self._pusher.stop()
             self._pusher = None
+        if self._registry_collector is not None:
+            reg, collect = self._registry_collector
+            reg.unregister_collector(collect)
+            self._registry_collector = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

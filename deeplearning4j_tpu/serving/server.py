@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -184,6 +185,16 @@ class ModelServer:
         self.push_url = push_url
         self.push_interval_s = float(push_interval_s)
         self._pusher = None
+        # Live reload (SERVING.md §Live reload): the published weight
+        # version currently serving (0 = boot weights, never hot-swapped)
+        # and the swap counter — both pushed to the federation plane so
+        # a router's canary gates can see WHICH version a host runs.
+        self.model_version = 0
+        self.swaps_total = 0
+        #: strong ref to the hot-swapped (params, state) trees: the
+        #: version-bound forward closures alias these on the device
+        self._live_weights = None
+        self._swap_lock = threading.Lock()
 
     @property
     def _batcher(self):
@@ -232,6 +243,128 @@ class ModelServer:
         if self._is_graph:
             return net.output(*feats)
         return net.output(feats[0])
+
+    # ----------------------------------------------------------- live reload
+    def _versioned_forward(self, params, state):
+        """A forward closure bound to PUBLISHED weights. The trick that
+        makes a hot swap free: the serving net's jitted apply already
+        takes ``(params, state)`` EXPLICITLY (multilayer._get_apply /
+        graph.output), so a closure that calls the SAME jitted function
+        with different trees reuses every compiled bucket executable —
+        0 fresh compiles, and replicas mid-rolling-swap (some on the old
+        version, some on the new) share one jit cache. Nothing on the
+        live net is mutated, so there is no publication race with
+        requests still finishing on the old weights."""
+        import jax.numpy as jnp
+        net = self._serving_net if self._serving_net is not None else self.net
+        if self._is_graph:
+            key = ("out", False, False)
+            if key not in net._apply_fns:
+                # build the graph's jitted output program exactly the
+                # way net.output() would (it closes over structure, not
+                # params) so swapped and unswapped paths share it
+                import jax
+
+                def fn(p, s, inputs, fmasks):
+                    acts, _, _, _ = net._walk(p, s, inputs, train=False,
+                                              rng=None, fmasks=fmasks)
+                    return tuple(acts[o] for o in net.conf.network_outputs)
+                net._apply_fns[key] = jax.jit(fn)
+
+            def forward(feats):
+                inputs, fmasks = net._prepare_inputs(
+                    [jnp.asarray(f) for f in feats], None)
+                outs = net._apply_fns[key](params, state, inputs, fmasks)
+                return outs[0] if len(outs) == 1 else list(outs)
+        else:
+            def forward(feats):
+                fn = net._get_apply(collect=False, train=False)
+                return fn(params, state, jnp.asarray(feats[0]), None, None)
+        return forward
+
+    def hot_swap(self, publication=None, *, net=None, version=None):
+        """Zero-downtime reload onto a published version: rolling
+        ``swap_forward`` over every replica, each one publish-then-drain
+        (fleet.py) — in-flight requests finish on the old weights while
+        new admissions run the new ones, and at no instant is the
+        replica out of routing. Decode sessions are not supported here
+        (their KV caches are entangled with the old weights — drain the
+        host and boot a new one off the shared compile cache instead;
+        the router fails sessions over via bit-identical re-prefill),
+        and mesh serving shards params at build time
+        (parallel/inference.py), so it swaps by host replacement too.
+
+        ``publication``: a serving.publish.Publication (its checkpoint
+        is restored here unless a pre-restored ``net`` is passed). The
+        publication's fingerprint must match the serving net's — same
+        param pytree structure is what guarantees the jit-cache reuse.
+        Returns a receipt dict: version, replicas swapped, wall time,
+        and the XLA compile delta across the swap itself (0 on a warmed
+        server — the budget-gated invariant)."""
+        if self.mesh is not None:
+            raise ValueError(
+                "hot_swap is the single-host replica path; mesh serving "
+                "shards params at build time — drain this host and boot "
+                "a replacement off the shared compile cache instead")
+        if self.decode_engine is not None:
+            raise ValueError(
+                "hot_swap cannot re-weight live decode sessions (KV "
+                "caches hold old-weight state) — drain the host; the "
+                "router re-prefills sessions onto survivors "
+                "bit-identically")
+        from deeplearning4j_tpu.compilecache.manifest import model_fingerprint
+        from deeplearning4j_tpu.serving import publish as _publish
+        with self._swap_lock:
+            if publication is not None:
+                if net is None:
+                    net = _publish.load_net(publication.path)
+                if version is None:
+                    version = publication.version
+                expect = publication.fingerprint
+            else:
+                if net is None:
+                    raise ValueError("hot_swap needs a publication or a "
+                                     "pre-restored net")
+                expect = model_fingerprint(net)
+            serving_fp = model_fingerprint(self.net)
+            if expect is not None and expect != serving_fp:
+                raise ValueError(
+                    f"published fingerprint {expect} does not match the "
+                    f"serving net's {serving_fp} — a hot swap can only "
+                    "bind weights with the identical param structure "
+                    "(different architecture ⇒ boot a new host)")
+            # Checkpoint restore commits leaves to an explicit device
+            # placement; the live net's params are uncommitted. jit keys
+            # on that distinction, so feeding restored leaves straight in
+            # retraces once per swap. Round-trip through host memory to
+            # shed the committed placement and hit the existing cache.
+            import jax
+            import jax.numpy as jnp
+
+            def _uncommit(tree):
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(np.asarray(a)), tree)
+            params = _uncommit(net.params)
+            state = _uncommit(net.state) if net.state else {}
+            forward = self._versioned_forward(params, state)
+            compile0 = _obs_metrics.compile_snapshot()
+            t0 = time.perf_counter()
+            swapped = 0
+            for r in list(self._fleet.replicas):
+                if r.status == "dead":
+                    continue  # an evicted slot keeps its slot semantics
+                self._fleet.swap_forward(r.index, forward)
+                swapped += 1
+            self._live_weights = (params, state)
+            self.model_version = int(version) if version is not None else \
+                self.model_version + 1
+            self.swaps_total += 1
+            delta = _obs_metrics.compile_delta(compile0)
+            return {"version": self.model_version,
+                    "fingerprint": serving_fp,
+                    "replicas_swapped": swapped,
+                    "swap_s": round(time.perf_counter() - t0, 6),
+                    "fresh_compiles": delta["count"]}
 
     def _infer_row_shapes(self):
         """Per-input row shapes (no batch dim) for warm-up, when they can
@@ -330,6 +463,19 @@ class ModelServer:
                    for k in range(len(chunks[0]))]
         else:
             out = (np.concatenate(chunks) if len(chunks) > 1 else chunks[0])
+        # serving NaN sentinel: count reply rows carrying non-finite
+        # values. The reply is still served (a canary's whole point is
+        # measuring the bad version on real traffic) — the counter rides
+        # the federation push, where the router's promotion gates kill
+        # the version before it leaves its traffic fraction.
+        nan_rows = 0
+        for a in (out if isinstance(out, list) else [out]):
+            a = np.asarray(a)
+            flat = a.reshape(a.shape[0], -1) if a.ndim > 1 \
+                else a.reshape(-1, 1)
+            nan_rows += int((~np.isfinite(flat).all(axis=1)).sum())
+        if nan_rows:
+            self.stats.record_nan_rows(nan_rows)
         self.stats.record_request(n, time.perf_counter() - t0)
         return out
 
@@ -444,6 +590,7 @@ class ModelServer:
                                            else "degraded"),
                                 "params": int(server.net.num_params()),
                                 "graph": server._is_graph,
+                                "model_version": server.model_version,
                                 "replicas": rows})
                 elif self.path.startswith("/metrics"):
                     if "format=snapshot" in self.path:
@@ -583,9 +730,21 @@ class ModelServer:
         """The health payload each federation push carries: readiness
         plus ``server_url`` — the key a FrontDoorRouter joins pushed
         gauges to its proxy target by."""
+        snap = self.stats.snapshot(self.shapes_seen)
         health = {"batcher_healthy": self._fleet.healthy,
                   "server_url": self.url,
-                  "replicas": self._fleet.describe()}
+                  "model_version": self.model_version,
+                  "replicas": self._fleet.describe(),
+                  # the canary-gate slice: the few counters a router's
+                  # promotion gates difference against their baseline
+                  # (serving/router.py start_canary/evaluate_canary)
+                  "serving": {
+                      "requests_total": snap["requests_total"],
+                      "errors_total": snap["errors_total"],
+                      "timeouts_total": snap["timeouts_total"],
+                      "nan_rows_total": snap["nan_rows_total"],
+                      "latency_p99_ms": snap["latency_ms"]["p99"],
+                  }}
         if self.decode_engine is not None:
             health["decode"] = self.decode_engine.describe()
         return health
@@ -600,6 +759,8 @@ class ModelServer:
         snap = self.stats.snapshot(self.shapes_seen)
         snap["replicas"] = self._fleet.describe()
         snap["requeued_total"] = self._fleet.requeued
+        snap["model_version"] = self.model_version
+        snap["weight_swaps_total"] = self.swaps_total
         if self.decode_engine is not None:
             snap["decode"] = self.decode_engine.describe()
         return snap
@@ -635,7 +796,16 @@ class ModelServer:
                 "dl4j_serving_requeued_total", "counter",
                 "Tickets resubmitted onto survivors after an eviction")
             requeued.add(self._fleet.requeued, {"server": addr})
-            return [depth, up, requeued]
+            version = MetricFamily(
+                "dl4j_serving_model_version", "gauge",
+                "Published weight version currently serving (0 = boot "
+                "weights, never hot-swapped)")
+            version.add(self.model_version, {"server": addr})
+            swaps = MetricFamily(
+                "dl4j_serving_weight_swaps_total", "counter",
+                "Completed zero-downtime weight hot swaps")
+            swaps.add(self.swaps_total, {"server": addr})
+            return [depth, up, requeued, version, swaps]
 
         reg = _obs_metrics.get_registry()
         reg.register_collector(_collect)

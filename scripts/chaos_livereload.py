@@ -1,0 +1,365 @@
+"""Live-reload chaos drill: versioned hot swap under load, canary
+gating, and rollback-as-a-verb — the receipt behind BUDGETS.json
+``live_reload`` (LIVERELOAD_r01.json).
+
+One topology, two arms, real HTTP end to end — a FrontDoorRouter
+federating two in-process ModelServer hosts, closed-loop clients
+hammering ``/predict`` the whole time:
+
+- **Good update (zero-downtime promotion).** Train a tiny MLN with the
+  resilience supervisor, publish the checkpoint (v1), train further,
+  publish again (v2). Both hosts boot on v1. Under live client load,
+  host B is hot-swapped to v2 and canaried at a pinned traffic
+  fraction; the canary passes its gates (live federation deltas) and
+  is promoted; host A then hot-swaps in a rolling pass over its
+  replicas. Every reply in the whole window must classify bit-exactly
+  as v1-weights or v2-weights output (no torn or garbage replies),
+  zero requests may be lost or errored, the longest gap between
+  successful completions across the swaps (the "blackout") is
+  measured, and the swap must compile NOTHING fresh — the publication
+  binds into the warmed jit cache (serving/publish.py fingerprint
+  discipline).
+
+- **Bad update (canary catch + rollback).** A poisoned v3 (all-NaN
+  params — the classic corrupted-promotion failure) is published and
+  boots on a third host, canaried at fraction 0.25. The serving NaN
+  sentinel (ModelServer.predict) counts poisoned reply rows, the
+  federation push carries them, and ``evaluate_canary`` kills the
+  version on the ``max_nan_rows`` gate — before ``min_requests``, one
+  poisoned reply is already the evidence. ``rollback_canary``
+  quarantines the host and flushes a flight-recorder artifact (reason
+  ``"rollback"``) naming the rejected version and the killing delta;
+  ``WeightStore.rollback`` repoints LATEST back to v2. Containment is
+  structural (token bucket: exposure can never exceed the fraction)
+  and the receipt proves it, plus post-rollback replies bit-identical
+  to the v2 reference.
+
+Run::
+
+    python scripts/chaos_livereload.py --out LIVERELOAD_r01.json
+    python scripts/check_budgets.py --bench LIVERELOAD_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _mlp(seed: int = 7):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(Dense(n_in=8, n_out=16, activation="relu"))
+            .layer(Output(n_in=16, n_out=4, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(url, path, obj, timeout=30.0):
+    req = urllib.request.Request(
+        url.rstrip("/") + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class _Clients:
+    """Closed-loop /predict load with per-reply bitwise version
+    classification and completion timestamps — the lost/blackout
+    evidence. ``tags``: "v1" / "v2" / "nan" / "other"."""
+
+    def __init__(self, url, x, refs, n_threads=8, pause_s=0.002):
+        import numpy as np
+        self.url, self.x = url, x.tolist()
+        self.refs = refs              # {"v1": ndarray, "v2": ndarray}
+        self.np = np
+        self.pause_s = pause_s
+        self.lock = threading.Lock()
+        self.sent = 0
+        self.results = []             # (t_done, tag) for 200 replies
+        self.http_errors = 0          # non-200 replies
+        self.lost = 0                 # no reply at all (timeout/reset)
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(n_threads)]
+
+    def _classify(self, preds):
+        arr = self.np.asarray(preds, self.np.float32)
+        for tag, ref in self.refs.items():
+            if arr.shape == ref.shape and self.np.array_equal(arr, ref):
+                return tag
+        if not self.np.isfinite(arr).all():
+            return "nan"
+        return "other"
+
+    def _run(self):
+        while not self._stop.is_set():
+            with self.lock:
+                self.sent += 1
+            try:
+                st, out = _post(self.url, "/predict",
+                                {"features": self.x})
+                t = time.time()
+                if st == 200:
+                    tag = self._classify(out["predictions"])
+                    with self.lock:
+                        self.results.append((t, tag))
+                else:
+                    with self.lock:
+                        self.http_errors += 1
+            except Exception:
+                with self.lock:
+                    self.lost += 1
+            if self.pause_s:
+                time.sleep(self.pause_s)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+    def counts(self):
+        with self.lock:
+            tags = {}
+            for _, tag in self.results:
+                tags[tag] = tags.get(tag, 0) + 1
+            return {"sent": self.sent, "ok": len(self.results),
+                    "http_errors": self.http_errors, "lost": self.lost,
+                    "tags": tags}
+
+    def max_gap_ms(self, t_from, t_to):
+        """Longest stretch inside [t_from, t_to] with no successful
+        completion — the observed swap blackout."""
+        with self.lock:
+            ts = sorted(t for t, _ in self.results)
+        marks = [t_from] + [t for t in ts if t_from <= t <= t_to] + [t_to]
+        return round(max(b - a for a, b in zip(marks, marks[1:])) * 1000, 3)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="LIVERELOAD_r01.json")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--fraction", type=float, default=0.25)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.observability import metrics as obs
+    from deeplearning4j_tpu.observability.flightrec import (
+        install_flight_recorder)
+    from deeplearning4j_tpu.serving import (FrontDoorRouter, ModelServer,
+                                            WeightStore, load_net)
+    from deeplearning4j_tpu.utils.checkpoint import save_checkpoint
+
+    work = tempfile.mkdtemp(prefix="livereload_")
+    install_flight_recorder(os.path.join(work, "flightrec"))
+    rng = np.random.default_rng(args.seed)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=256)]
+    x = X[:4]
+
+    # ---- train -> publish v1, train more -> publish v2 (the seam) ----
+    train_dir = os.path.join(work, "train")
+    store = WeightStore(os.path.join(work, "store"), keep=3)
+    net = _mlp(args.seed)
+    net.resilient_fit(X, Y, checkpoint_dir=train_dir, epochs=1,
+                      batch_size=32, checkpoint_every_steps=4,
+                      keep_checkpoints=3)
+    p1 = store.publish_latest(train_dir, source=train_dir)
+    net.resilient_fit(X, Y, checkpoint_dir=train_dir, epochs=2,
+                      batch_size=32, checkpoint_every_steps=4,
+                      keep_checkpoints=3)
+    p2 = store.publish_latest(train_dir, source=train_dir)
+    assert p2.version > p1.version
+
+    # ---- poisoned v3: all-NaN params, the corrupted promotion ----
+    import jax
+    import jax.numpy as jnp
+    netP = load_net(p2.path)
+    netP.params = jax.tree_util.tree_map(
+        lambda a: jnp.full_like(a, jnp.nan), netP.params)
+    poison_ckpt = os.path.join(work, "poison", "step_999")
+    save_checkpoint(netP, poison_ckpt)
+    p3 = store.publish(poison_ckpt, source="poisoned")
+
+    ref_v1 = np.asarray(load_net(p1.path).output(x))
+    ref_v2 = np.asarray(load_net(p2.path).output(x))
+    assert not np.array_equal(ref_v1, ref_v2)
+
+    # ---- topology: router + 2 hosts on v1, heartbeats pushing ----
+    router = FrontDoorRouter(stale_after_s=5.0).start()
+    push = router.url + "/api/metrics_push"
+    host_a = ModelServer(load_net(p1.path), port=0, replicas=2,
+                         batch_window_ms=1.0, push_url=push,
+                         push_interval_s=0.25).start()
+    host_b = ModelServer(load_net(p1.path), port=0, replicas=1,
+                         batch_window_ms=1.0, push_url=push,
+                         push_interval_s=0.25).start()
+    router.add_host(host_a.url)
+    router.add_host(host_b.url)
+
+    receipt = {"config": "live_reload",
+               "model": "mlp 8-16-4 (resilient_fit checkpoints)",
+               "clients": args.clients,
+               "canary_fraction": args.fraction,
+               "created_unix": round(time.time(), 3),
+               "store": store.describe(),
+               "versions": {"v1": p1.version, "v2": p2.version,
+                            "v3_poisoned": p3.version}}
+    host_c = None
+    clients = _Clients(router.url, x, {"v1": ref_v1, "v2": ref_v2},
+                       n_threads=args.clients).start()
+    try:
+        time.sleep(1.0)  # load + first heartbeat pushes land
+
+        # ---- arm 1: canary v2 on host B, promote, roll host A ----
+        compile0 = obs.compile_snapshot()
+        t_swap0 = time.time()
+        swap_b = host_b.hot_swap(p2)
+        router.start_canary(host_b.url, version=p2.version,
+                            fraction=args.fraction, max_nan_rows=0,
+                            min_requests=20, max_p99_ratio=10.0)
+        verdict = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            verdict = router.evaluate_canary()
+            if verdict["decision"] != "wait":
+                break
+            time.sleep(0.2)
+        if verdict is None or verdict["decision"] != "pass":
+            raise RuntimeError(f"good canary did not pass: {verdict}")
+        promoted = router.promote_canary()
+        swap_a = host_a.hot_swap(p2)
+        t_swap1 = time.time()
+        time.sleep(0.5)  # post-swap serving inside the compile window
+        serve_delta = obs.compile_delta(compile0)
+        blackout_ms = clients.max_gap_ms(t_swap0, t_swap1 + 0.25)
+        receipt["good_update"] = {
+            "swap_host_b": swap_b, "swap_host_a": swap_a,
+            "canary_verdict": verdict, "promoted": promoted,
+            "swap_window_s": round(t_swap1 - t_swap0, 3),
+            "swap_window_compiles": serve_delta["count"]}
+        receipt["swap_fresh_compiles"] = (swap_a["fresh_compiles"]
+                                          + swap_b["fresh_compiles"]
+                                          + serve_delta["count"])
+        receipt["swap_blackout_ms"] = blackout_ms
+        arm1 = clients.counts()
+
+        # ---- arm 2: poisoned v3 canary on a fresh host C ----
+        host_c = ModelServer(load_net(p3.path), port=0, replicas=1,
+                             batch_window_ms=1.0, push_url=push,
+                             push_interval_s=0.25).start()
+        router.start_canary(host_c.url, version=p3.version,
+                            fraction=args.fraction, max_nan_rows=0,
+                            min_requests=50)
+        verdict = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            verdict = router.evaluate_canary()
+            if verdict["decision"] == "fail":
+                break
+            time.sleep(0.2)
+        if verdict is None or verdict["decision"] != "fail":
+            raise RuntimeError(f"poisoned canary not caught: {verdict}")
+        rb = router.rollback_canary(verdict, reason="nan sentinel tripped")
+        store_after = store.rollback(
+            "canary v%d failed: %s" % (p3.version,
+                                       verdict["killed_by"]["gate"]))
+        host_c.stop()
+        host_c = None
+        clients.stop()
+        arm2 = clients.counts()
+
+        # flight-recorder artifact: parse it back, prove the verb left
+        # a post-mortem trail naming the rejected version
+        with open(rb["artifact"]) as f:
+            flight = json.load(f)
+        ev = next(e for e in flight["events"]
+                  if e["kind"] == "canary_rollback")
+        ev_detail = json.loads(ev["detail"])
+        assert ev_detail["rejected_version"] == p3.version
+        assert flight["reason"] == "rollback"
+
+        # post-rollback: the fleet serves v2, bit for bit
+        post_ok = 0
+        for _ in range(20):
+            st, out = _post(router.url, "/predict", {"features": x.tolist()})
+            if st == 200 and np.array_equal(
+                    np.asarray(out["predictions"], np.float32), ref_v2):
+                post_ok += 1
+        exposed = arm2["tags"].get("nan", 0) - arm1["tags"].get("nan", 0)
+        arm2_reqs = arm2["ok"] - arm1["ok"]
+        exposure = (exposed / arm2_reqs) if arm2_reqs else 0.0
+
+        receipt["bad_update"] = {
+            "canary_verdict": verdict, "rollback": {
+                k: v for k, v in rb.items() if k != "artifact"},
+            "rollback_artifact": rb["artifact"],
+            "flight_reason": flight["reason"],
+            "rejected_version_in_artifact": ev_detail["rejected_version"],
+            "store_latest_after_rollback": store_after.version,
+            "canary_requests_window": arm2_reqs,
+            "canary_exposed_replies": exposed,
+            "post_rollback_checks": post_ok}
+        rstats = router.describe()
+        receipt["router"] = {k: rstats[k] for k in (
+            "requests_total", "canary_routed_total", "promotions_total",
+            "rollbacks_total", "auto_evicted_total", "evicted_total",
+            "quarantined")}
+        receipt["traffic"] = arm2
+        # ---- the gated scalars ----
+        receipt["requests_total"] = arm2["sent"]
+        receipt["lost_requests"] = arm2["lost"]
+        receipt["client_errors"] = arm2["http_errors"]
+        receipt["unclassified_replies"] = arm2["tags"].get("other", 0)
+        receipt["promotions"] = rstats["promotions_total"]
+        receipt["rollback_events"] = rstats["rollbacks_total"]
+        receipt["nan_rows_detected"] = verdict["deltas"]["nan_rows"]
+        receipt["canary_exposure_fraction"] = round(exposure, 4)
+        receipt["canary_contained"] = int(
+            0 < exposed and exposure <= args.fraction)
+        receipt["post_rollback_bit_identical"] = int(post_ok == 20)
+        receipt["store_latest_is_v2"] = int(store_after.version
+                                            == p2.version)
+    finally:
+        clients.stop()
+        if host_c is not None:
+            host_c.stop()
+        host_a.stop()
+        host_b.stop()
+        router.stop()
+
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(receipt, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    print(json.dumps({k: receipt[k] for k in (
+        "requests_total", "lost_requests", "client_errors",
+        "swap_blackout_ms", "swap_fresh_compiles", "promotions",
+        "rollback_events", "canary_exposure_fraction",
+        "canary_contained", "post_rollback_bit_identical")}, indent=1))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,457 @@
+"""Live-weight serving tests (serving/publish.py + hot swap + canary):
+the versioned publication store (atomic landing, monotonic versions,
+fingerprint stamping, rollback-as-a-verb, retention), the guarded
+``ReplicaSet.restart``, zero-compile hot swap under concurrent load,
+heartbeat-silence auto-eviction in the FrontDoorRouter, token-bucket
+canary containment with metric-delta gates, the rollback flight
+artifact, and the ``live_reload`` budget gate (including a
+demonstrable failure)."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import metrics as obs
+from deeplearning4j_tpu.observability.distributed import MetricsFederation
+from deeplearning4j_tpu.observability.flightrec import (
+    install_flight_recorder, uninstall_flight_recorder)
+from deeplearning4j_tpu.serving import (FrontDoorRouter, ModelServer,
+                                        ReplicaSet, ServingStats,
+                                        WeightStore, load_net)
+from deeplearning4j_tpu.utils.checkpoint import save_checkpoint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+import check_budgets  # noqa: E402  (scripts/check_budgets.py)
+
+
+def _mlp(seed: int = 1):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(Dense(n_in=8, n_out=16, activation="relu"))
+            .layer(Output(n_in=16, n_out=4, activation="softmax",
+                          loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _echo_forward(feats):
+    return np.asarray(feats[0], np.float32) * 2.0
+
+
+def _triple_forward(feats):
+    return np.asarray(feats[0], np.float32) * 3.0
+
+
+def _dying_forward(feats):
+    raise SystemExit("chaos: simulated device loss")
+
+
+def _push(fed, tag, url, serving=None, extra_health=None):
+    """One fabricated federation heartbeat for host ``url`` — the wire
+    shape ModelServer._push_health produces, minus the noise."""
+    health = {"server_url": url}
+    if serving is not None:
+        health["serving"] = serving
+    if extra_health:
+        health.update(extra_health)
+    fed.ingest({"schema": 1, "identity": {"tag": tag},
+                "time": time.time(), "families": [], "health": health})
+
+
+def _routable_hosts(router, exclude=()):
+    return [h for h, _ in router._routable(exclude)]
+
+
+# ------------------------------------------------------------- weight store
+def test_publish_store_versions_fingerprint_rollback_retention(tmp_path):
+    netA, netB = _mlp(1), _mlp(2)
+    cpA = str(tmp_path / "train" / "step_10")
+    cpB = str(tmp_path / "train" / "step_20")
+    save_checkpoint(netA, cpA)
+    save_checkpoint(netB, cpB)
+
+    store = WeightStore(str(tmp_path / "store"), keep=2)
+    assert store.latest() is None
+    p1 = store.publish(cpA, source=cpA)
+    p2 = store.publish(cpB)
+    assert (p1.version, p2.version) == (1, 2)
+    assert store.latest().version == 2
+    # same config, different seeds: identical fingerprint (the hot-swap
+    # compatibility key is structure, not values)
+    assert p1.fingerprint and p1.fingerprint == p2.fingerprint
+    # atomic landing left no staging debris
+    assert not [n for n in os.listdir(store.root) if n.startswith(".")]
+
+    # retention: keep=2, third publication GCs v1
+    p3 = store.publish(cpA)
+    assert [p.version for p in store.versions()] == [2, 3]
+
+    # rollback is a verb: v3 rejected (with the reason), LATEST -> v2
+    back = store.rollback("canary failed: nan rows")
+    assert back.version == 2 and store.latest().version == 2
+    v3 = store.get(3)
+    assert v3.rejected and v3.meta["rejected_reason"].startswith("canary")
+    # a rejected version is never a rollback target; with no earlier
+    # good version left the verb refuses rather than serving v3 again
+    with pytest.raises(RuntimeError):
+        store.rollback("again")
+
+    # publications restore to bit-identical outputs, with leaves
+    # de-committed so they bind into a warmed server's jit cache
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    loaded = load_net(p2.path)
+    assert np.array_equal(np.asarray(loaded.output(x)),
+                          np.asarray(netB.output(x)))
+    import jax
+    leaf = jax.tree_util.tree_leaves(loaded.params)[0]
+    assert not getattr(leaf, "_committed", False)
+
+
+def test_publish_rejects_incomplete_checkpoint(tmp_path):
+    os.makedirs(str(tmp_path / "half"))
+    store = WeightStore(str(tmp_path / "store"))
+    with pytest.raises(ValueError):
+        store.publish(str(tmp_path / "half"))
+    with pytest.raises(FileNotFoundError):
+        store.publish_latest(str(tmp_path))
+
+
+# -------------------------------------------------------- guarded restart
+def test_restart_live_replica_is_guarded():
+    """restart() on a live healthy replica would silently drop its
+    queued tickets — it must demand a drain first (PR 17)."""
+    rs = ReplicaSet(_echo_forward, 2, max_batch=4, batch_window_ms=0.0)
+    rs.start()
+    try:
+        with pytest.raises(RuntimeError, match="drain"):
+            rs.restart(0)
+        rs.drain(0)
+        assert rs.restart(0).status == "live"
+    finally:
+        rs.stop()
+
+
+def test_swap_forward_rebinds_stats_depth_and_serves_new_weights():
+    stats = ServingStats()
+    rs = ReplicaSet(_echo_forward, 2, max_batch=4, batch_window_ms=0.0,
+                    stats=stats)
+    rs.start()
+    try:
+        x = np.ones((2, 4), np.float32)
+        assert np.array_equal(
+            np.asarray(rs.submit([x]).result(timeout=10)), x * 2.0)
+        for r in rs.replicas:
+            rs.swap_forward(r.index, _triple_forward)
+        out = np.asarray(rs.submit([x]).result(timeout=10))
+        assert np.array_equal(out, x * 3.0)
+        # _make_batcher rebinds the shared stats' depth fn to the fresh
+        # batcher; swap_forward must restore the fleet-total view
+        rs.replicas[0].batcher._pending.append(object())
+        rs.replicas[1].batcher._pending.append(object())
+        assert stats.queue_depth_fn() == 2
+        rs.replicas[0].batcher._pending.clear()
+        rs.replicas[1].batcher._pending.clear()
+    finally:
+        rs.stop()
+
+
+def test_mid_swap_replica_death_requeues_onto_swapped_survivor():
+    """Kill replica 1 while replica 0 is being hot-swapped: every
+    in-flight request still completes (old or new weights, never
+    garbage), nothing is lost."""
+    rs = ReplicaSet(_echo_forward, 2, max_batch=4, batch_window_ms=1.0,
+                    max_queue=1024)
+    rs.start()
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(48, 4)).astype(np.float32)
+        futs = [rs.submit([x[i:i + 1]]) for i in range(16)]
+        rs.replicas[1].batcher._forward = _dying_forward
+        rs.swap_forward(0, _triple_forward)
+        futs += [rs.submit([x[i:i + 1]]) for i in range(16, 48)]
+        for i, f in enumerate(futs):
+            r = np.asarray(f.result(timeout=30))
+            assert (np.array_equal(r, x[i:i + 1] * 2.0)
+                    or np.array_equal(r, x[i:i + 1] * 3.0)), f"row {i}"
+        assert rs.describe()[1]["status"] == "dead"
+    finally:
+        rs.stop()
+
+
+# ------------------------------------------------------ hot swap under load
+def test_hot_swap_under_load_zero_loss_zero_compiles():
+    """The tentpole invariant end to end: concurrent clients across a
+    rolling hot swap see zero errors, zero lost/doubled replies, every
+    reply bit-identical to either the old or the new weights' output,
+    and the swap window compiles NOTHING fresh."""
+    netA, netB = _mlp(1), _mlp(2)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    ref_a = np.asarray(netA.output(x))
+    ref_b = np.asarray(netB.output(x))
+    assert not np.array_equal(ref_a, ref_b)
+
+    srv = ModelServer(netA, replicas=2, batch_window_ms=1.0)
+    srv._fleet.warm([(8,)])
+    srv._fleet.start()
+    try:
+        assert np.array_equal(np.asarray(srv.predict([x])), ref_a)
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def client(n=40):
+            for _ in range(n):
+                try:
+                    out = np.asarray(srv.predict([x]))
+                    with lock:
+                        if np.array_equal(out, ref_a):
+                            results.append("a")
+                        elif np.array_equal(out, ref_b):
+                            results.append("b")
+                        else:
+                            results.append("?")
+                except Exception as e:  # analysis: ok — ledger, re-raised via errors list
+                    with lock:
+                        errors.append(repr(e))
+
+        base = obs.compile_snapshot()
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)          # some old-weight replies land first
+        rec = srv.hot_swap(net=netB, version=2)
+        for t in threads:
+            t.join(timeout=60)
+        delta = obs.compile_delta(base)
+
+        assert errors == []
+        assert len(results) == 6 * 40          # none lost, none doubled
+        assert "?" not in results              # never torn/garbage
+        assert "a" in results and "b" in results
+        # post-swap serving is the new weights, bit for bit
+        assert np.array_equal(np.asarray(srv.predict([x])), ref_b)
+        assert rec["fresh_compiles"] == 0
+        assert delta["count"] == 0, delta
+        assert rec["replicas_swapped"] == 2
+        assert srv.model_version == 2 and srv.swaps_total == 1
+        assert srv.metrics()["model_version"] == 2
+    finally:
+        srv._fleet.stop()
+
+
+def test_hot_swap_rejects_structure_mismatch_and_nan_sentinel_counts():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    other_conf = (NeuralNetConfiguration.builder().seed(3).list()
+                  .layer(Dense(n_in=8, n_out=32, activation="relu"))
+                  .layer(Output(n_in=32, n_out=4, activation="softmax",
+                                loss="mcxent"))
+                  .build())
+    other = MultiLayerNetwork(other_conf).init()
+    srv = ModelServer(_mlp(1), replicas=1, batch_window_ms=0.0)
+    srv._fleet.start()
+    try:
+        with pytest.raises(ValueError, match="fingerprint"):
+            srv.hot_swap(net=other)
+        with pytest.raises(ValueError, match="publication or"):
+            srv.hot_swap()
+        # the serving NaN sentinel: poisoned weights -> counted rows in
+        # the stats AND in the pushed canary-gate slice
+        import jax
+        import jax.numpy as jnp
+        poisoned = _mlp(2)
+        poisoned.params = jax.tree_util.tree_map(
+            lambda a: jnp.full_like(a, jnp.nan), poisoned.params)
+        srv.hot_swap(net=poisoned, version=7)
+        x = np.ones((3, 8), np.float32)
+        out = np.asarray(srv.predict([x]))
+        assert not np.isfinite(out).all()
+        snap = srv.stats.snapshot()
+        assert snap["nan_rows_total"] == 3
+        assert srv._push_health()["serving"]["nan_rows_total"] == 3
+        assert srv._push_health()["model_version"] == 7
+    finally:
+        srv._fleet.stop()
+
+
+# -------------------------------------------------- router auto-eviction
+def test_router_auto_evicts_heartbeat_silent_host():
+    fed = MetricsFederation(stale_after_s=0.05, evict_after_factor=None)
+    router = FrontDoorRouter(federation=fed, evict_after_factor=2.0)
+    h = router.add_host("http://127.0.0.1:59991")
+    never_pushed = router.add_host("http://127.0.0.1:59992")
+    _push(fed, "h1", h.base_url)
+    assert h in _routable_hosts(router)       # fresh heartbeat: routable
+    time.sleep(0.15)                       # > 2 x stale_after_s silent
+    routable = _routable_hosts(router)
+    assert h not in routable
+    assert h.status == "dead"
+    assert router.auto_evicted_total == 1
+    assert router.evicted_total == 1
+    assert router.describe()["auto_evicted_total"] == 1
+    # a host that never pushed is trusted, not killed — the metrics
+    # plane is a routing signal, not an admission gate
+    assert never_pushed in routable
+    # threshold below the stale bound is rejected at construction
+    with pytest.raises(ValueError):
+        FrontDoorRouter(evict_after_factor=0.5)
+    # None disables auto-eviction: stale hosts are skipped, not evicted
+    fed2 = MetricsFederation(stale_after_s=0.05, evict_after_factor=None)
+    router2 = FrontDoorRouter(federation=fed2, evict_after_factor=None)
+    h2 = router2.add_host("http://127.0.0.1:59993")
+    _push(fed2, "h2", h2.base_url)
+    time.sleep(0.15)
+    assert h2 not in _routable_hosts(router2) and h2.status == "live"
+
+
+# ------------------------------------------------------------- canary verbs
+def test_canary_token_bucket_containment_and_promotion():
+    fed = MetricsFederation(stale_after_s=30.0)
+    router = FrontDoorRouter(federation=fed)
+    stable = router.add_host("http://127.0.0.1:59994")
+    canary = router.add_host("http://127.0.0.1:59995")
+    _push(fed, "s", stable.base_url,
+          serving={"requests_total": 100, "errors_total": 0,
+                   "nan_rows_total": 0, "latency_p99_ms": 4.0})
+    _push(fed, "c", canary.base_url,
+          serving={"requests_total": 0, "errors_total": 0,
+                   "nan_rows_total": 0, "latency_p99_ms": None})
+
+    with pytest.raises(ValueError):
+        router.start_canary(canary.base_url, fraction=0.6)
+    router.start_canary(canary.base_url, version=5, fraction=0.25,
+                        min_requests=10)
+    with pytest.raises(RuntimeError, match="already active"):
+        router.start_canary(stable.base_url)
+    # the canary host leaves stable routing entirely
+    assert canary not in _routable_hosts(router)
+    # token bucket: exactly fraction x picks go to the canary — its
+    # share can never exceed the fraction, by construction
+    picks = [router._pick_canary_admitted(()) for _ in range(100)]
+    assert picks.count(canary) == 25
+    assert router.canary_routed_total == 25
+
+    v = router.evaluate_canary()
+    assert v["decision"] == "wait"          # not enough canary traffic
+    _push(fed, "c", canary.base_url,
+          serving={"requests_total": 40, "errors_total": 0,
+                   "nan_rows_total": 0, "latency_p99_ms": 6.0})
+    v = router.evaluate_canary()
+    assert v["decision"] == "pass" and v["deltas"]["requests"] == 40
+    out = router.promote_canary()
+    assert out["version"] == 5
+    assert router.promotions_total == 1
+    assert router.describe()["canary"] is None
+    assert canary in _routable_hosts(router)   # back in stable routing
+
+
+def test_canary_error_rate_gate_kills():
+    fed = MetricsFederation(stale_after_s=30.0)
+    router = FrontDoorRouter(federation=fed)
+    canary = router.add_host("http://127.0.0.1:59996")
+    _push(fed, "c", canary.base_url,
+          serving={"requests_total": 0, "errors_total": 0,
+                   "nan_rows_total": 0, "latency_p99_ms": None})
+    router.start_canary(canary.base_url, version=6, fraction=0.2,
+                        min_requests=10, max_error_rate_delta=0.05)
+    _push(fed, "c", canary.base_url,
+          serving={"requests_total": 20, "errors_total": 5,
+                   "nan_rows_total": 0, "latency_p99_ms": 5.0})
+    v = router.evaluate_canary()
+    assert v["decision"] == "fail"
+    assert v["killed_by"]["gate"] == "max_error_rate_delta"
+    assert v["killed_by"]["measured"] == 0.25
+
+
+def test_canary_nan_gate_rollback_flushes_flight_artifact(tmp_path):
+    """Satellite 3: a failed canary's rollback leaves a flight-recorder
+    artifact (reason "rollback") naming the rejected version and the
+    metric delta that killed it — parseable, the post-mortem trail."""
+    install_flight_recorder(str(tmp_path))
+    try:
+        fed = MetricsFederation(stale_after_s=30.0)
+        router = FrontDoorRouter(federation=fed)
+        stable = router.add_host("http://127.0.0.1:59997")
+        canary = router.add_host("http://127.0.0.1:59998")
+        _push(fed, "s", stable.base_url,
+              serving={"requests_total": 50, "errors_total": 0,
+                       "nan_rows_total": 0, "latency_p99_ms": 4.0})
+        _push(fed, "c", canary.base_url,
+              serving={"requests_total": 0, "errors_total": 0,
+                       "nan_rows_total": 0, "latency_p99_ms": None})
+        router.start_canary(canary.base_url, version=9, fraction=0.25,
+                            max_nan_rows=0, min_requests=50)
+        # a decode session pinned to the canary must fail over after
+        # the rollback (its pin is dropped; history re-prefill heals)
+        router._affinity["sid-1"] = canary
+        # one poisoned reply: the NaN gate kills BEFORE min_requests
+        _push(fed, "c", canary.base_url,
+              serving={"requests_total": 3, "errors_total": 0,
+                       "nan_rows_total": 2, "latency_p99_ms": 5.0})
+        v = router.evaluate_canary()
+        assert v["decision"] == "fail"
+        assert v["killed_by"]["gate"] == "max_nan_rows"
+        assert v["deltas"]["requests"] < 50   # killed early, as designed
+
+        rb = router.rollback_canary(v, reason="nan sentinel tripped")
+        assert router.rollbacks_total == 1
+        assert rb["sessions_dropped"] == 1
+        assert "sid-1" not in router._affinity
+        # quarantined: out of ALL routing until reinstate()
+        assert canary.base_url in router.describe()["quarantined"]
+        assert canary not in _routable_hosts(router)
+        with pytest.raises(RuntimeError, match="quarantined"):
+            router.start_canary(canary.base_url)
+
+        # the artifact: reason "rollback", the event names version 9
+        # and the killing gate
+        assert rb["artifact"] and os.path.exists(rb["artifact"])
+        assert router.last_rollback_artifact == rb["artifact"]
+        with open(rb["artifact"]) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "rollback"
+        ev = next(e for e in doc["events"]
+                  if e["kind"] == "canary_rollback")
+        detail = json.loads(ev["detail"])
+        assert detail["rejected_version"] == 9
+        assert detail["killed_by"]["gate"] == "max_nan_rows"
+        assert detail["killed_by"]["measured"] == 2
+        assert detail["reason"] == "nan sentinel tripped"
+
+        assert router.reinstate(canary.base_url) is True
+        assert canary in _routable_hosts(router)
+    finally:
+        uninstall_flight_recorder()
+
+
+# ------------------------------------------------------------- budget gate
+def test_livereload_receipt_passes_committed_budgets():
+    art = os.path.join(_REPO, "LIVERELOAD_r01.json")
+    assert os.path.exists(art), "commit LIVERELOAD_r01.json " \
+        "(scripts/chaos_livereload.py --out LIVERELOAD_r01.json)"
+    assert check_budgets.main(["--bench", art]) == 0
+
+
+def test_livereload_budget_gate_fails_on_lost_requests(tmp_path):
+    """The demonstrably-failing bound: a receipt reporting a single
+    lost request or a fresh swap compile must fail the gate."""
+    art = os.path.join(_REPO, "LIVERELOAD_r01.json")
+    with open(art) as f:
+        receipt = json.load(f)
+    bad = dict(receipt)
+    bad["lost_requests"] = 1
+    bad["swap_fresh_compiles"] = 2
+    p = str(tmp_path / "tampered.json")
+    with open(p, "w") as f:
+        json.dump(bad, f)
+    assert check_budgets.main(["--bench", p]) == 1

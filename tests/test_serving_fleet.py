@@ -195,6 +195,7 @@ def test_drain_and_restart_readmission():
     stats = ServingStats()
     rs2 = ReplicaSet(_echo_forward, 2, max_queue=16, stats=stats)
     rs2.replicas[0].batcher._pending.append(object())
+    rs2.drain(1)   # restart on a live replica is guarded (PR 17)
     rs2.restart(1)
     rs2.replicas[1].batcher._pending.append(object())
     assert stats.queue_depth_fn() == 2
