@@ -581,6 +581,25 @@ def run_config(name: str) -> dict:
             "inter_token_p99_ms", "decode_bit_identical", "logits_exact",
             "chunk_interleave_ratio", "pool_dedup_ratio",
             "compile_delta_after_warm", "model")}
+    if name == "speculative":
+        # speculative decode goodput: copy-task-trained gpt_mini target +
+        # gpt_mini_draft, draft-on vs draft-off tokens/sec on the same
+        # trained nets (scripts/serve_bench.py --decode --speculative has
+        # the full TRANSFORMER_r03 report; this is the fast tracked entry)
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "serve_bench.py")
+        spec = importlib.util.spec_from_file_location("serve_bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rep = mod.bench_decode_speculative(sessions=4, gen_tokens=12,
+                                           fit_steps=30)
+        return {k: rep.get(k) for k in (
+            "decode_tokens_per_sec", "spec_off_tokens_per_sec",
+            "spec_speedup_vs_off", "spec_accept_tokens_per_step",
+            "spec_rounds", "spec_accepted", "spec_rejected",
+            "spec_bit_identical", "compile_delta_after_warm", "model",
+            "draft_model")}
     if name == "mixed_precision":
         return bench_mixed_precision()
     raise ValueError(f"unknown bench config '{name}'")
@@ -633,7 +652,7 @@ def _timed(fn) -> float:
 
 
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
-            "transformer", "serving", "decode", "host_loop",
+            "transformer", "serving", "decode", "speculative", "host_loop",
             "trace_overhead", "goodput_overhead", "identity_overhead",
             "lockcheck_overhead", "input_pipeline", "mixed_precision")
 

@@ -56,6 +56,17 @@ part of the ticket:
   evicts an idle session, its token history (kept here, tiny) is
   re-prefilled on its next step — bit-identical recovery, counted in
   ``reprefills``.
+- **Speculative decoding (PR 18).** ``generate`` with ``draft_net=`` +
+  ``speculative=k`` (env ``DL4J_TPU_SPECULATIVE_K``, 0 = kill switch,
+  default off) replaces k single-token target launches per round with k
+  cheap draft steps plus ONE batched verify forward (the mask-first
+  all-position-logits extend variant). Acceptance is exact argmax match
+  against the target's own logits — the first mismatch truncates the
+  round, the target's logits row supplies the corrected token, and the
+  rejected positions roll back (``KVPagePool.truncate``) — so the
+  emitted stream is BIT-IDENTICAL to plain greedy decode; only the
+  launch count changes. Verify buckets are explicit rungs on the warm
+  ladder, so the post-warm compile delta stays 0.
 
 Numeric contract (PRECISION.md / PERF.md §14): everything inside the
 streaming tier — prefill, chunk, step, pool round-trip, re-prefill after
@@ -98,10 +109,20 @@ class StreamingKVForward:
     the row's current frontier and returns the segment's last-real-token
     logits plus the new leaves — bit-identical to feeding those tokens
     one by one (mask-padded rows write only beyond their new frontier,
-    which later writes overwrite before anything attends there). Leaves
-    flatten in deterministic (sorted-key) pytree order; warm-up's
-    float32 zero rows are cast to each leaf's canonical dtype on entry
-    so the jit cache sees ONE signature per bucket.
+    which later writes overwrite before anything attends there). Verify
+    ``[mask [b,s], x [b,s,V], *cache leaves]`` — MASK-FIRST, which is
+    what marks it at the same arity as extend — is the all-position-
+    logits extend variant for speculative decode: one batched forward
+    advances the cache by the whole draft-proposed segment and returns
+    ``[logits [b,s,V], *new leaves]``, the next-token logits at EVERY
+    fed position, so the target can judge all k proposals from a single
+    launch. Each row of that logits tensor is bit-identical to what the
+    single-token decode op would have produced at that position (the
+    same fixed-extent contract as extend), which is what makes exact-
+    argmax acceptance equal plain greedy decode. Leaves flatten in
+    deterministic (sorted-key) pytree order; warm-up's float32 zero rows
+    are cast to each leaf's canonical dtype on entry so the jit cache
+    sees ONE signature per bucket.
     """
 
     def __init__(self, net):
@@ -115,6 +136,7 @@ class StreamingKVForward:
         self._jit_prefill = jax.jit(self._prefill_impl)
         self._jit_decode = jax.jit(self._decode_impl)
         self._jit_extend = jax.jit(self._extend_impl)
+        self._jit_verify = jax.jit(self._verify_impl)
         self._carry_def = None
         # eager 1-row probe pins the carry treedef + canonical dtypes
         vocab = int(net.layers[0].conf.n_in)
@@ -197,6 +219,22 @@ class StreamingKVForward:
         new_leaves, _ = jax.tree_util.tree_flatten(self._extract(ns))
         return [logits] + new_leaves
 
+    def _verify_impl(self, params, mask, x, *leaves):
+        # extend's masked advance, but returning the logits at EVERY fed
+        # position instead of only the last real token's — the
+        # speculative-verify op (padded rows carry garbage logits beyond
+        # their segment; the host reads only the real positions)
+        carries = jax.tree_util.tree_unflatten(self._carry_def, list(leaves))
+        state = {ln: dict(sub) for ln, sub in self.net.state.items()}
+        for ln, sub in carries.items():
+            merged = dict(state.get(ln, {}))
+            merged.update(sub)
+            state[ln] = merged
+        out, ns = self.net._forward(params, state, x, train=False, rng=None,
+                                    fmask=mask)
+        new_leaves, _ = jax.tree_util.tree_flatten(self._extract(ns))
+        return [out] + new_leaves
+
     # ----------------------------------------------------------------- entry
     def __call__(self, feats: list):
         self._enter()
@@ -206,6 +244,17 @@ class StreamingKVForward:
                     self.net.params, self.net.state,
                     jnp.asarray(feats[0], jnp.float32),
                     jnp.asarray(feats[1], jnp.float32))
+            elif len(feats) == 2 + self.n_carries \
+                    and np.ndim(feats[0]) == 2:
+                # mask-first at extend arity = the verify variant: same
+                # per-row shapes in a different input order, so the
+                # batcher's compatibility key keeps the two phases in
+                # separate buckets without an extra marker input
+                leaves = [jnp.asarray(f, dt)
+                          for f, dt in zip(feats[2:], self._carry_dtypes)]
+                out = self._jit_verify(
+                    self.net.params, jnp.asarray(feats[0], jnp.float32),
+                    jnp.asarray(feats[1], jnp.float32), *leaves)
             elif len(feats) == 2 + self.n_carries:
                 leaves = [jnp.asarray(f, dt)
                           for f, dt in zip(feats[2:], self._carry_dtypes)]
@@ -243,7 +292,8 @@ class DecodeSession:
 
 @guarded_by("_lock", "_sessions", "prefills", "decode_steps", "reprefills",
             "prefill_chunks", "chunked_prefills", "interleaved_prefills",
-            "prefix_hits", "shared_tokens")
+            "prefix_hits", "shared_tokens", "spec_rounds", "spec_proposed",
+            "spec_accepted", "spec_rejected")
 class DecodeEngine:
     """Sessionful autoregressive decode over a ``ReplicaSet``.
 
@@ -266,6 +316,24 @@ class DecodeEngine:
     Both features require token-axis cache carries (the attention
     ``[1, C, H, dh]`` shape) and silently stay off for nets without
     them (e.g. pure-LSTM carries), preserving the legacy path.
+
+    PR 18 knob — **speculative decoding**, default OFF:
+
+    - ``speculative`` (env ``DL4J_TPU_SPECULATIVE_K``, default 0 = kill
+      switch) with ``draft_net=``: each ``generate`` round the draft net
+      autoregressively proposes ``k`` tokens, then the target verifies
+      all of them in ONE batched verify forward (the all-position-logits
+      extend variant). Acceptance is exact argmax match — the first
+      mismatch truncates the round, the target's own logits row supplies
+      the corrected token, and the cache rolls back to the accept
+      frontier (``KVPagePool.truncate`` on the draft side, accept-point
+      ``put`` on the target side) — so the emitted stream is
+      BIT-IDENTICAL to plain greedy decode; speculation only changes how
+      many target launches it costs. With ``k=0`` or no ``draft_net``
+      the engine is byte-for-byte the plain PR 16 path. Requires
+      token-axis carries like the other PR 16 features (silently off
+      otherwise) and a draft whose vocab matches the target's (rejected
+      with ``ValueError`` at construction).
     """
 
     def __init__(self, net, *, replicas: int = 1, pool: KVPagePool = None,
@@ -275,7 +343,8 @@ class DecodeEngine:
                  min_prompt_bucket: int = 8, stats=None,
                  request_timeout_s: float = 300.0,
                  prefix_sharing: Optional[bool] = None,
-                 prefill_chunk_pages: Optional[int] = None):
+                 prefill_chunk_pages: Optional[int] = None,
+                 speculative: Optional[int] = None, draft_net=None):
         self.forward = StreamingKVForward(net)
         self.fleet = ReplicaSet(self.forward, replicas, max_batch=max_batch,
                                 batch_window_ms=batch_window_ms,
@@ -314,6 +383,53 @@ class DecodeEngine:
         self.interleaved_prefills = 0  # ...during which decode advanced
         self.prefix_hits = 0           # prefills that adopted shared pages
         self.shared_tokens = 0         # prefill tokens skipped via sharing
+        self.spec_rounds = 0           # draft-propose/target-verify rounds
+        self.spec_proposed = 0         # draft tokens proposed
+        self.spec_accepted = 0         # proposals matching the target argmax
+        self.spec_rejected = 0         # proposals truncated at a mismatch
+        # ---- speculative decode (PR 18): default OFF; k = 0 kills it
+        explicit_spec = speculative is not None
+        if speculative is None:
+            speculative = int(os.environ.get(
+                "DL4J_TPU_SPECULATIVE_K", "0") or 0)
+        k = max(0, int(speculative))
+        if k and draft_net is None and explicit_spec:
+            raise ValueError(
+                f"speculative={k} needs a draft_net= to propose with — "
+                "pass one (zoo.gpt_mini_draft matches zoo.gpt_mini) or "
+                "set speculative=0")
+        self.spec_k = 0
+        self._draft: Optional["DecodeEngine"] = None
+        if k and draft_net is not None and can_page:
+            dv = int(draft_net.layers[0].conf.n_in)
+            if dv != self.forward.vocab_size:
+                raise ValueError(
+                    f"speculative draft/target vocab mismatch: the draft "
+                    f"proposes over {dv} tokens but the target verifies "
+                    f"over {self.forward.vocab_size} — exact-argmax "
+                    "acceptance needs the SAME tokenizer/vocab on both "
+                    "nets; build the draft with zoo.gpt_mini_draft("
+                    f"vocab_size={self.forward.vocab_size})")
+            draft_ext = self._max_prompt(draft_net)
+            if draft_ext < self.max_prompt:
+                raise ValueError(
+                    f"speculative draft cache extent {draft_ext} is "
+                    f"shorter than the target's {self.max_prompt} — the "
+                    "draft must track the whole session; build it with "
+                    f"max_cache_len={self.max_prompt} (or longer)")
+            # the draft rides its OWN single-replica engine (tiny model,
+            # own pool, no nested speculation); prefix sharing lets each
+            # round's resync adopt the previous round's pages
+            self._draft = DecodeEngine(
+                draft_net, replicas=1, n_pages=self.pool.n_pages,
+                page_tokens=self.pool.page_tokens, max_batch=max_batch,
+                batch_window_ms=batch_window_ms, max_queue=max_queue,
+                min_batch=min_batch, min_prompt_bucket=min_prompt_bucket,
+                request_timeout_s=request_timeout_s,
+                prefix_sharing=prefix_sharing,
+                prefill_chunk_pages=prefill_chunk_pages,
+                speculative=0)
+            self.spec_k = k
 
     @staticmethod
     def _max_prompt(net) -> int:
@@ -374,6 +490,18 @@ class DecodeEngine:
             for t in sorted(ext_rungs):
                 compiled += self.fleet.warm([(t, v), (t,)] + carry,
                                             skip=())
+        if self.spec_k:
+            # explicit verify rungs: every bucket a round can produce —
+            # the segment is nxt + up to k proposals, and both the
+            # token budget and the cache extent can shrink the cap
+            vr = set()
+            for cap in range(2, self.spec_k + 2):
+                for seg in range(2, cap + 1):
+                    vr.add(next_bucket(seg, cap, self.min_prompt_bucket))
+            for t in sorted(vr):
+                compiled += self.fleet.warm([(t,), (t, v)] + carry,
+                                            skip=())
+            compiled += self._draft.warm()
         return sorted(set(compiled))
 
     def _await(self, fut, sid: str, what: str):
@@ -480,6 +608,10 @@ class DecodeEngine:
         if sess is None:
             raise KeyError(f"unknown decode session '{sid}'")
         if sess.tokens + 1 > self.max_prompt:
+            # the session can never advance again — release its pool
+            # pages so the capacity returns to live sessions (the tiny
+            # host record stays for close_session bookkeeping)
+            self.pool.drop(sid)
             raise ValueError(f"session '{sid}' is at the cache extent "
                              f"{self.max_prompt}")
         leaves = self.pool.get(sid)
@@ -504,21 +636,179 @@ class DecodeEngine:
                       ids=sess.ids if self._sharing else None)
         return logits[0]
 
+    # ---------------------------------------------------------- speculative
+    def _rollback(self, sid: str, to_tokens: int) -> bool:
+        """Roll session ``sid`` back to its first ``to_tokens`` fed
+        tokens: refcount-safe page release via ``pool.truncate`` (the
+        position carries move back to the new frontier; the pageable
+        leaves' stale tail is dropped by the pool) plus the history trim.
+        Returns ``False`` when the pool can't truncate (dense entry, or
+        evicted) — the caller re-prefills from history instead."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None or to_tokens < 1 or to_tokens > sess.tokens:
+            return False
+        others = {}
+        for i, rs in enumerate(self.forward.carry_row_shapes):
+            if len(rs) < 2:
+                others[i] = np.full((1,) + tuple(rs), to_tokens,
+                                    self.forward._carry_dtypes[i])
+        if not self.pool.truncate(sid, to_tokens, others=others):
+            return False
+        del sess.ids[to_tokens:]
+        return True
+
+    def _sync_logits(self, sid: str, want: List[int]) -> np.ndarray:
+        """Next-token logits with session ``sid``'s fed history equal to
+        ``want`` — the draft-side resync between speculative rounds.
+        Reuses the live session when its history is a prefix of ``want``
+        (stepping just the missing suffix — the common case: rounds
+        extend each other), rolls a diverged tail back to the common
+        prefix via ``_rollback`` first, and otherwise falls back to a
+        full prefill (which, with prefix sharing on, re-adopts its own
+        sealed pages, so even the fallback is incremental)."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is not None:
+            have = list(sess.ids)
+            n = 0
+            for a, b in zip(have, want):
+                if a != b:
+                    break
+                n += 1
+            if n < len(have):
+                # diverged tail (the previous round's rejected drafts)
+                have = have[:n] if n >= 1 and self._rollback(sid, n) \
+                    else None
+            if have is not None and len(have) < len(want):
+                logits = None
+                for t in want[len(have):]:
+                    logits = self.step(sid, t)
+                return logits
+        return self.prefill(sid, want)
+
+    def _propose(self, sid: str, want: List[int], k: int) -> List[int]:
+        """``k`` greedy draft proposals continuing ``want`` — runs on the
+        draft engine (its own fleet/pool); the last proposal is left
+        un-fed, the next round's resync settles it."""
+        d = self._draft
+        logits = d._sync_logits(sid, want)
+        props: List[int] = []
+        for _ in range(k):
+            t = int(np.argmax(logits))
+            props.append(t)
+            if len(props) < k:
+                logits = d.step(sid, t)
+        return props
+
+    def _spec_round(self, sid: str, nxt: int, max_new: int):
+        """One draft-propose / target-verify round: the draft proposes
+        ``k`` tokens continuing ``nxt``, the target verifies all of them
+        in ONE batched verify forward, and exact argmax match decides
+        acceptance — the first mismatch truncates the round and the
+        target's own logits row supplies the corrected next token, so
+        the emitted stream is bit-identical to plain greedy decode.
+        Returns ``(emitted, next_token)`` where ``emitted`` (>= 1
+        tokens, starting with ``nxt``) is exactly what was fed and kept,
+        or ``None`` when speculation can't run here (cache extent too
+        close) and the caller should take a plain step."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None:
+            raise KeyError(f"unknown decode session '{sid}'")
+        base = sess.tokens
+        k = min(self.spec_k, int(max_new), self.max_prompt - base - 1)
+        if k < 1:
+            return None
+        props = self._propose(sid, sess.ids + [int(nxt)], k)
+        leaves = self.pool.get(sid)
+        if leaves is None:
+            # evicted mid-round: the same bit-identical re-prefill
+            # recovery as step()
+            with self._lock:
+                self.reprefills += 1
+            leaves = self._run_prefill(sid, sess.ids)[1]
+        seq = [int(nxt)] + props
+        cap = min(self.spec_k + 1, self.max_prompt - base)
+        bt = next_bucket(len(seq), cap, self.min_prompt_bucket)
+        x = self._one_hot(seq, bt)
+        mask = np.zeros((1, bt), np.float32)
+        mask[0, :len(seq)] = 1.0
+        # mask-first feats mark the verify (all-position-logits) variant
+        res = self._await(self.fleet.submit([mask, x] + list(leaves),
+                                            session=sid), sid, "verify")
+        rows, new_leaves = res[0][0], list(res[1:])
+        emitted = [int(nxt)]
+        accepted = 0
+        nxt2 = None
+        for i in range(k):
+            g = int(np.argmax(rows[i]))
+            if props[i] == g:
+                emitted.append(g)
+                accepted += 1
+            else:
+                nxt2 = g    # the target's own corrected token
+                break
+        if nxt2 is None:
+            # full accept: the last logits row is a free plain step
+            nxt2 = int(np.argmax(rows[k]))
+        kept = base + len(emitted)
+        if len(emitted) < len(seq):
+            # roll back to the accept frontier: position carries move
+            # back; the pageable leaves keep their stale tail, which the
+            # fixed-extent contract guarantees is overwritten before it
+            # is ever attended (and the pool stores only kept tokens)
+            for i, rs in enumerate(self.forward.carry_row_shapes):
+                if len(rs) < 2:
+                    new_leaves[i] = np.full(
+                        (1,) + tuple(rs), kept,
+                        self.forward._carry_dtypes[i])
+        sess.ids.extend(emitted)
+        sess.last_step = time.time()
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_proposed += k
+            self.spec_accepted += accepted
+            self.spec_rejected += k - accepted
+        self.pool.put(sid, sess.tokens, new_leaves,
+                      ids=sess.ids if self._sharing else None)
+        return emitted, nxt2
+
     def generate(self, sid: str, ids: Sequence[int], n_tokens: int,
                  *, step_times: Optional[list] = None) -> List[int]:
-        """Greedy decode: prefill then ``n_tokens`` argmax steps. Returns
-        the generated ids; ``step_times`` (if given) collects per-step
-        wall seconds — the inter-token latency sample stream."""
+        """Greedy decode: prefill then ``n_tokens`` argmax tokens —
+        plain single-token steps, or draft-propose/target-verify rounds
+        when speculation is on (same stream either way, bit-identical).
+        Returns the generated ids; ``step_times`` (if given) collects
+        per-token wall seconds — the inter-token latency sample stream
+        (a speculative round's wall time is amortized over the tokens it
+        emitted)."""
+        n = int(n_tokens)
         logits = self.prefill(sid, ids)
-        out = []
+        out: List[int] = []
+        if n <= 0:
+            return out
         nxt = int(np.argmax(logits))
-        for _ in range(int(n_tokens)):
+        while len(out) < n:
+            left = n - len(out)
+            if self.spec_k and left >= 2:
+                t0 = time.perf_counter()
+                r = self._spec_round(sid, nxt, left - 1)
+                if r is not None:
+                    emitted, nxt = r
+                    if step_times is not None:
+                        dt = (time.perf_counter() - t0) / len(emitted)
+                        step_times.extend([dt] * len(emitted))
+                    out.extend(emitted)
+                    continue
             out.append(nxt)
             t0 = time.perf_counter()
             logits = self.step(sid, nxt)
             if step_times is not None:
                 step_times.append(time.perf_counter() - t0)
-            nxt = int(np.argmax(logits))
+            if len(out) < n:
+                # the final step's argmax would be discarded — skip it
+                nxt = int(np.argmax(logits))
         return out
 
     def close_session(self, sid: str) -> bool:
@@ -529,6 +819,8 @@ class DecodeEngine:
         # for their other holders, exclusively-held pages free here
         self.pool.drop(sid)
         self.fleet.forget_session(sid)
+        if self._draft is not None:
+            self._draft.close_session(sid)
         return known
 
     # ----------------------------------------------------------------- state
@@ -551,7 +843,25 @@ class DecodeEngine:
                  shared_tokens=self.shared_tokens,
                  prefill_chunk_tokens=self._chunk_tokens,
                  prefix_sharing=self._sharing)
+        steps = self.decode_steps + self.spec_rounds
+        d.update(speculative_k=self.spec_k,
+                 spec_rounds=self.spec_rounds,
+                 spec_proposed=self.spec_proposed,
+                 spec_accepted=self.spec_accepted,
+                 spec_rejected=self.spec_rejected,
+                 # tokens emitted per target decode launch: plain steps
+                 # emit 1 each; a verify round emits 1 + its accepts
+                 spec_accept_tokens_per_step=(
+                     round((steps + self.spec_accepted) / steps, 4)
+                     if (self.spec_k and steps) else None),
+                 # rollbacks live in the DRAFT's pool (the target resets
+                 # position carries host-side instead)
+                 spec_draft_truncations=(
+                     self._draft.pool.truncations
+                     if self._draft is not None else None))
         return d
 
     def stop(self):
+        if self._draft is not None:
+            self._draft.stop()
         self.fleet.stop()

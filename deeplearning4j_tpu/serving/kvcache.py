@@ -92,7 +92,8 @@ class _Entry:
 
 
 @guarded_by("_lock", "_table", "_shared", "_layout", "evictions",
-            "evicted_pages", "page_hits", "prefix_matches")
+            "evicted_pages", "page_hits", "prefix_matches", "truncations",
+            "truncated_pages")
 class KVPagePool:
     """Fixed-capacity page accounting + copy-on-write store for
     decode-session cache state.
@@ -125,6 +126,8 @@ class KVPagePool:
         self.evicted_pages = 0      # pages actually freed by those drops
         self.page_hits = 0          # sealed pages deduped against peers
         self.prefix_matches = 0     # match_prefix adoptions
+        self.truncations = 0        # speculative-reject rollbacks
+        self.truncated_pages = 0    # pages freed by those rollbacks
 
     # ------------------------------------------------------------ accounting
     def pages_for(self, tokens: int) -> int:
@@ -177,7 +180,9 @@ class KVPagePool:
                     "dedup_ratio": (round(logical / used, 4) if used
                                     else None),
                     "page_hits": self.page_hits,
-                    "prefix_matches": self.prefix_matches}
+                    "prefix_matches": self.prefix_matches,
+                    "truncations": self.truncations,
+                    "truncated_pages": self.truncated_pages}
 
     # ------------------------------------------------------------- internals
     def _pageable_layout(self, tokens: int, leaves) -> Optional[tuple]:
@@ -363,11 +368,15 @@ class KVPagePool:
                 chain = chain[:len(chain) - (len(chain) % step)]
             if not chain:
                 return 0, None
+            # take the new references BEFORE releasing any old entry for
+            # this sid: a live session re-prefilling over its own sealed
+            # pages (repeat wire-op generate, speculative resync) would
+            # otherwise free the very pages the chain just matched
+            for key in chain:
+                self._shared[key].ref += 1
             old = self._table.pop(sid, None)
             if old is not None:
                 self._release_locked(old)
-            for key in chain:
-                self._shared[key].ref += 1
             ent = _Entry()
             ent.chain = list(chain)
             ent.tokens = len(chain) * pt
@@ -380,6 +389,66 @@ class KVPagePool:
                 partial[i] = (np.concatenate(parts, axis=1)
                               if len(parts) > 1 else parts[0])
             return ent.tokens, partial
+
+    def truncate(self, sid: str, to_tokens: int, others=None) -> bool:
+        """Roll session ``sid`` back to its first ``to_tokens`` tokens —
+        the speculative-decode reject path (serving/decode.py): positions
+        fed past the accept point must leave the store. Drops the private
+        partial tail past the accept point, decrements references on (and
+        frees, at refcount zero) every sealed page wholly beyond it, and
+        re-slices the boundary page's prefix into a fresh private tail
+        when the accept point lands mid-page. COW-safe by construction:
+        shared pages are immutable and only ever de-referenced here, so a
+        page another session still holds survives untouched. ``others``
+        (optional ``{leaf idx: replacement leaf}``) overwrites the
+        non-pageable leaves — the pool treats them as opaque, so the
+        caller owns their semantics (the decode engine moves its position
+        carries back to the new frontier). Returns ``False`` when the
+        session is absent, stored dense (opaque — the caller re-prefills
+        from history instead), or ``to_tokens`` is not a shrink within
+        the admission floor of one token."""
+        to_tokens = int(to_tokens)
+        with self._lock:
+            ent = self._table.get(sid)
+            if (ent is None or not ent.paged or to_tokens < 1
+                    or to_tokens > ent.tokens):
+                return False
+            if to_tokens < ent.tokens:
+                pt = self.page_tokens
+                n_full = to_tokens // pt
+                rem = to_tokens - n_full * pt
+                tail = None
+                if rem:
+                    if len(ent.chain) > n_full:
+                        boundary = self._shared[ent.chain[n_full]]
+                        tail = [np.ascontiguousarray(s[:, :rem])
+                                for s in boundary.slices]
+                    elif ent.tail is not None:
+                        tail = [np.ascontiguousarray(t[:, :rem])
+                                for t in ent.tail]
+                    else:       # nothing backs the boundary tokens
+                        return False
+                freed = 1 if ent.tail is not None else 0
+                for key in ent.chain[n_full:]:
+                    page = self._shared.get(key)
+                    if page is None:
+                        continue
+                    page.ref -= 1
+                    if page.ref <= 0:
+                        del self._shared[key]
+                        freed += 1
+                if tail is not None:
+                    freed -= 1   # the rebuilt tail still charges a page
+                ent.chain = ent.chain[:n_full]
+                ent.tail = tail
+                ent.tokens = to_tokens
+                self.truncations += 1
+                self.truncated_pages += max(0, freed)
+            if others:
+                merged = dict(ent.others)
+                merged.update({int(i): v for i, v in others.items()})
+                ent.others = sorted(merged.items())
+            return True
 
     def drop(self, sid: str) -> bool:
         """Voluntary release (session closed) — decrements this

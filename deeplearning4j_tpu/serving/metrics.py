@@ -350,4 +350,21 @@ def decode_metric_families(describe: dict, labels=None):
     fam("dl4j_decode_reprefills_total", "counter",
         "Evicted sessions re-admitted bit-identically from history",
         describe.get("reprefills"))
+    if describe.get("speculative_k"):
+        fam("dl4j_decode_spec_rounds_total", "counter",
+            "Speculative draft-propose/target-verify rounds run",
+            describe.get("spec_rounds"))
+        fam("dl4j_decode_spec_proposed_total", "counter",
+            "Draft tokens proposed for target verification",
+            describe.get("spec_proposed"))
+        fam("dl4j_decode_spec_accepted_total", "counter",
+            "Draft proposals accepted by exact target-argmax match",
+            describe.get("spec_accepted"))
+        fam("dl4j_decode_spec_rejected_total", "counter",
+            "Draft proposals truncated at the first argmax mismatch",
+            describe.get("spec_rejected"))
+        fam("dl4j_decode_spec_accept_tokens_per_step", "gauge",
+            "Tokens emitted per target decode launch (1.0 = plain "
+            "decode; the speculative speedup lever)",
+            describe.get("spec_accept_tokens_per_step"))
     return fams

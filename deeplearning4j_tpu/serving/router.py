@@ -679,10 +679,11 @@ class FrontDoorRouter:
         unknown sid)."""
         op = payload.get("op")
         sid = payload.get("sid")
-        if not sid or op not in ("prefill", "step", "close"):
+        if not sid or op not in ("prefill", "step", "generate", "close"):
             return (400, json.dumps(
                 {"error": "decode payload needs op "
-                          "(prefill|step|close) and sid"}).encode(), [])
+                          "(prefill|step|generate|close) and sid"})
+                .encode(), [])
         if op == "prefill":
             ids = [int(i) for i in payload.get("ids") or ()]
             if not ids:
@@ -728,6 +729,47 @@ class FrontDoorRouter:
             backend = [(BACKEND_HEADER, served.base_url)] \
                 if served is not None else []
             return 200, json.dumps({"closed": closed}).encode(), backend
+        if op == "generate":
+            # multi-token proxy: the host runs the whole greedy loop
+            # (speculatively when its engine carries a draft); the
+            # router still owns the canonical history, so failover and
+            # replay semantics match step — history grows only by the
+            # tokens a 200 reply confirmed
+            with self._lock:
+                ids = [int(i) for i in (payload.get("ids")
+                                        or self._history.get(sid) or ())]
+            if not ids:
+                return (400, json.dumps(
+                    {"error": "generate needs ids (or a prior "
+                              "prefill)"}).encode(), [])
+            with self._lock:
+                self._history[sid] = list(ids)
+
+            def gpick(tried):
+                if not tried:
+                    return self._pick_affine(sid)
+                h = self._pick(exclude=tried)
+                if h is not None:
+                    with self._lock:
+                        self.failovers_total += 1
+                        self.affinity_misses += 1
+                        self._affinity[sid] = h
+                return h
+
+            body = json.dumps({
+                "op": "generate", "sid": sid, "ids": ids,
+                "n_tokens": int(payload.get("n_tokens", 0))}).encode()
+            status, data, headers, _ = self._route("/decode", body,
+                                                   trace_id, gpick)
+            if status == 200:
+                toks = json.loads(data.decode() or "{}").get("tokens") \
+                    or ()
+                with self._lock:
+                    hist = self._history.get(sid)
+                    if hist is not None:
+                        hist.extend(int(t) for t in toks)
+                    self.decode_steps_total += len(toks)
+            return status, data, headers
         # step
         with self._lock:
             history = list(self._history.get(sid) or ())

@@ -642,6 +642,20 @@ class ModelServer:
                     logits = eng.step(sid, int(payload["token"]))
                     return {"logits": np.asarray(logits).tolist(),
                             "recovered": recovered}
+                if op == "generate":
+                    # multi-token op: the host runs the whole greedy
+                    # loop (speculative rounds when the engine has a
+                    # draft), so speculation's launch savings survive
+                    # the wire — a per-step protocol would serialize
+                    # every token through a round trip
+                    ids = payload.get("ids") or ()
+                    if not ids:
+                        raise KeyError(
+                            f"decode generate for '{sid}' needs ids")
+                    toks = eng.generate(sid, [int(i) for i in ids],
+                                        int(payload.get("n_tokens", 0)))
+                    return {"tokens": [int(t) for t in toks],
+                            "speculative": bool(eng.spec_k)}
                 if op == "close":
                     return {"closed": eng.close_session(sid)}
                 raise ValueError(f"unknown decode op {op!r}")

@@ -8,6 +8,7 @@ from deeplearning4j_tpu.zoo.models import (
     VGG16_MEAN_RGB,
     char_rnn,
     gpt_mini,
+    gpt_mini_draft,
     gpt_mini_tp_rules,
     lenet,
     mnist_mlp,
@@ -18,5 +19,5 @@ from deeplearning4j_tpu.zoo.models import (
 )
 
 __all__ = ["BF16", "F32", "VGG16_MEAN_RGB", "char_rnn", "gpt_mini",
-           "gpt_mini_tp_rules", "lenet", "mnist_mlp", "resnet18",
-           "resnet50", "vgg16", "vgg16_preprocess"]
+           "gpt_mini_draft", "gpt_mini_tp_rules", "lenet", "mnist_mlp",
+           "resnet18", "resnet50", "vgg16", "vgg16_preprocess"]

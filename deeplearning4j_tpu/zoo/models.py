@@ -234,6 +234,22 @@ def gpt_mini(vocab_size: int = 80, width: int = 256, n_layers: int = 4,
     return MultiLayerNetwork(conf).init()
 
 
+def gpt_mini_draft(vocab_size: int = 80, width: int = 128,
+                   n_layers: int = 2, n_heads: int = 2, max_len: int = 256,
+                   max_cache_len: Optional[int] = None, seed: int = 43,
+                   dtype: Optional[DtypePolicy] = None) -> MultiLayerNetwork:
+    """Draft-sized companion to ``gpt_mini`` for speculative decode
+    (serving/decode.py): the SAME vocab/tokenizer contract — acceptance
+    is exact argmax match against the target, so the two nets must index
+    the same token space — at half the width and depth, so a draft
+    forward costs a fraction of a target forward. Pass the target's
+    ``vocab_size``/``max_cache_len`` when building the pair; the decode
+    engine rejects a vocab mismatch at construction."""
+    return gpt_mini(vocab_size=vocab_size, width=width, n_layers=n_layers,
+                    n_heads=n_heads, max_len=max_len,
+                    max_cache_len=max_cache_len, seed=seed, dtype=dtype)
+
+
 def gpt_mini_tp_rules():
     """Tensor-parallel placement for ``gpt_mini`` (regex form,
     parallel/tensor.py match semantics, first match wins): column-parallel

@@ -30,7 +30,8 @@ def main():
                                        "mnist_mlp", "resnet18", "host_loop",
                                        "trace_overhead", "goodput_overhead",
                                        "input_pipeline", "mixed_precision",
-                                       "serving", "transformer"])
+                                       "serving", "transformer",
+                                       "speculative"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--seq", type=int, default=64)
@@ -124,6 +125,29 @@ def main():
                   "inter_token_p99_ms", "decode_bit_identical",
                   "kv_pool_occupancy", "kv_evictions", "reprefills",
                   "affinity_hit_rate", "train_mfu", "train_tokens_per_sec"):
+            if k in rep:
+                out[k] = rep[k]
+        finish(out)
+        return
+
+    if args.config == "speculative":
+        # speculative decode probe: either summarize an existing
+        # serve_bench.py --decode --speculative --out receipt
+        # (TRANSFORMER_r03.json) or run the bench.py fast entry inline
+        # (draft-on vs draft-off tokens/sec on copy-task-trained nets)
+        out = {"config": "speculative"}
+        if args.serving_results:
+            with open(args.serving_results) as f:
+                rep = json.load(f)
+            out["results_file"] = args.serving_results
+        else:
+            from bench import run_config
+            rep = run_config("speculative")
+        for k in ("model", "draft_model", "decode_tokens_per_sec",
+                  "spec_off_tokens_per_sec", "spec_speedup_vs_off",
+                  "spec_accept_tokens_per_step", "spec_rounds",
+                  "spec_proposed", "spec_accepted", "spec_rejected",
+                  "spec_bit_identical", "compile_delta_after_warm"):
             if k in rep:
                 out[k] = rep[k]
         finish(out)

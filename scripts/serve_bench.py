@@ -321,7 +321,8 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
                  batch_window_ms: float = 2.0, vocab: int = 32,
                  width: int = 64, n_layers: int = 2, n_heads: int = 4,
                  max_cache_len: int = 128, shared_prefix: int = 32,
-                 stagger_s: float = 0.04) -> dict:
+                 stagger_s: float = 0.04, net=None,
+                 speculative_k: int = 0, draft_net=None) -> dict:
     """Mixed prefill/decode open-arrival load (config ``transformer``,
     the TRANSFORMER_r02 arm): ``sessions`` greedy-decode clients arrive
     STAGGERED (``stagger_s`` apart, open arrival — not a closed-loop
@@ -340,15 +341,21 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
     chunks, shares, and coalesces without changing a single output (the
     fixed-extent-cache contract, ops/attention.py). The receipt also
     carries the post-warm compile delta: the chunk ladder must add no
-    fresh compiles during the timed run."""
+    fresh compiles during the timed run.
+
+    ``net=`` substitutes a prebuilt (possibly trained) target;
+    ``speculative_k``/``draft_net`` turn on speculative decoding — the
+    references stay sequential ``rnn_time_step``, so the bit-identity
+    check then covers chunking + sharing + speculation stacked."""
     from deeplearning4j_tpu.observability.metrics import (compile_delta,
                                                           compile_snapshot)
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.zoo import F32, gpt_mini
 
-    net = gpt_mini(vocab_size=vocab, width=width, n_layers=n_layers,
-                   n_heads=n_heads, max_len=max_cache_len,
-                   max_cache_len=max_cache_len, dtype=F32)
+    if net is None:
+        net = gpt_mini(vocab_size=vocab, width=width, n_layers=n_layers,
+                       n_heads=n_heads, max_len=max_cache_len,
+                       max_cache_len=max_cache_len, dtype=F32)
     rng = np.random.default_rng(0)
     # shared system prompt + per-group suffix; 3-ish sessions per group
     prefix = [int(t) for t in rng.integers(0, vocab, shared_prefix)]
@@ -385,7 +392,9 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
 
     eng = DecodeEngine(net, replicas=replicas, n_pages=n_pages,
                        page_tokens=page_tokens, max_batch=max_batch,
-                       batch_window_ms=batch_window_ms)
+                       batch_window_ms=batch_window_ms,
+                       speculative=int(speculative_k),
+                       draft_net=draft_net)
     t0 = time.perf_counter()
     eng.warm()
     warmup_s = time.perf_counter() - t0
@@ -449,6 +458,26 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
             1000.0 * s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
 
     hits, misses = desc["affinity_hits"], desc["affinity_misses"]
+    spec: dict = {}
+    if speculative_k:
+        # run-delta accepted-tokens-per-step: tokens emitted per target
+        # decode launch (plain steps emit 1; a verify round emits
+        # 1 + its accepts) — the speculative speedup lever the budget
+        # gates at > 1.0
+        steps_run = ((desc["decode_steps"] - pre["decode_steps"])
+                     + (desc["spec_rounds"] - pre["spec_rounds"]))
+        acc_run = desc["spec_accepted"] - pre["spec_accepted"]
+        spec = {
+            "speculative_k": speculative_k,
+            "spec_rounds": desc["spec_rounds"] - pre["spec_rounds"],
+            "spec_proposed": desc["spec_proposed"] - pre["spec_proposed"],
+            "spec_accepted": acc_run,
+            "spec_rejected": desc["spec_rejected"] - pre["spec_rejected"],
+            "spec_accept_tokens_per_step":
+                round((steps_run + acc_run) / steps_run, 4)
+                if steps_run else None,
+            "spec_draft_truncations": desc.get("spec_draft_truncations"),
+        }
     return {
         "config": "transformer",
         "model": f"gpt_mini vocab{vocab} w{width} L{n_layers} "
@@ -498,7 +527,85 @@ def bench_decode(sessions: int = 12, gen_tokens: int = 24,
         "compile_delta_after_warm": cdelta["count"],
         "affinity_hit_rate": round(hits / (hits + misses), 4)
         if hits + misses else None,
+        **spec,
     }
+
+
+def _fit_copy_lm(net, vocab: int = 32, steps: int = 80, batch: int = 8,
+                 seq: int = 32, max_run: int = 5, seed: int = 0) -> int:
+    """Briefly fit ``net`` on a run-structured copy task: sequences are
+    short constant runs, so "next token = current token" is usually
+    right. Fitting BOTH the target and the draft on this makes their
+    greedy continuations genuinely correlate — the speculative bench's
+    acceptance rate is then measured, not assumed (random-weight models
+    would agree only by 1/vocab chance)."""
+    from deeplearning4j_tpu.datasets import DataSet
+    rng = np.random.default_rng(seed)
+    rows = np.arange(seq)
+    for _ in range(steps):
+        toks = np.empty((batch, seq), np.int64)
+        for b in range(batch):
+            pos = 0
+            while pos < seq:
+                t = int(rng.integers(0, vocab))
+                end = min(seq, pos + int(rng.integers(2, max_run + 1)))
+                toks[b, pos:end] = t
+                pos = end
+        x = np.zeros((batch, seq, vocab), np.float32)
+        y = np.zeros((batch, seq, vocab), np.float32)
+        for b in range(batch):
+            x[b, rows, toks[b]] = 1.0
+            y[b, rows, np.concatenate([toks[b, 1:], toks[b, :1]])] = 1.0
+        net.fit_batch(DataSet(x, y))
+    return steps
+
+
+def bench_decode_speculative(sessions: int = 12, gen_tokens: int = 24,
+                             spec_k: int = 3, fit_steps: int = 80,
+                             **kw) -> dict:
+    """The TRANSFORMER_r03 arm: the r02 mixed open-arrival decode load
+    with chunked prefill + COW prefix sharing + SPECULATIVE DECODING all
+    on. Builds a copy-task-trained gpt_mini target and gpt_mini_draft
+    draft (same vocab, half width, one layer), runs the r02 load once
+    with speculation OFF and once with it ON (same trained nets, same
+    prompts), and publishes the comparison: accepted-tokens-per-step,
+    tokens/sec vs the off arm, and the bit-identity verdict for the
+    fully stacked path (check_budgets gates
+    ``min_spec_accept_tokens_per_step`` and ``min_spec_bit_identical``
+    on this receipt)."""
+    from deeplearning4j_tpu.zoo import F32, gpt_mini, gpt_mini_draft
+
+    vocab, cache = 32, 128
+    target = gpt_mini(vocab_size=vocab, width=64, n_layers=2, n_heads=4,
+                      max_len=cache, max_cache_len=cache, dtype=F32)
+    draft = gpt_mini_draft(vocab_size=vocab, width=32, n_layers=1,
+                           n_heads=2, max_len=cache, max_cache_len=cache,
+                           dtype=F32)
+    _fit_copy_lm(target, vocab=vocab, steps=fit_steps)
+    _fit_copy_lm(draft, vocab=vocab, steps=fit_steps)
+
+    off = bench_decode(sessions=sessions, gen_tokens=gen_tokens,
+                       net=target, **kw)
+    if "error" in off:
+        return off
+    on = bench_decode(sessions=sessions, gen_tokens=gen_tokens,
+                      net=target, speculative_k=spec_k, draft_net=draft,
+                      **kw)
+    if "error" in on:
+        return on
+    on["model"] += " [copy-task-trained]"
+    on["draft_model"] = (f"gpt_mini_draft vocab{vocab} w32 L1 h2 f32 "
+                         f"(cache {cache})")
+    on["copy_fit_steps"] = fit_steps
+    on["spec_off_tokens_per_sec"] = off["decode_tokens_per_sec"]
+    on["spec_speedup_vs_off"] = (
+        round(on["decode_tokens_per_sec"] / off["decode_tokens_per_sec"], 4)
+        if off["decode_tokens_per_sec"] else None)
+    # bit-identity for the fully stacked path (chunking + sharing +
+    # speculation): same check as r02's, named so the budget gate can
+    # pin it independently
+    on["spec_bit_identical"] = on["decode_bit_identical"]
+    return on
 
 
 # ------------------------------------------------------------- fleet bench
@@ -676,6 +783,15 @@ def main():
                          "sharing on (config transformer; the "
                          "TRANSFORMER_r02.json receipt, gated by "
                          "check_budgets)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="with --decode: the TRANSFORMER_r03 arm — "
+                         "copy-task-trained target + gpt_mini_draft, "
+                         "speculation off then on over the same r02 "
+                         "load, accepted-tokens/step and tokens/sec "
+                         "comparison (gated by check_budgets)")
+    ap.add_argument("--spec-k", type=int, default=3,
+                    help="draft tokens proposed per speculative round "
+                         "(--decode --speculative)")
     ap.add_argument("--sessions", type=int, default=12,
                     help="concurrent decode sessions (--decode)")
     ap.add_argument("--gen-tokens", type=int, default=24,
@@ -699,8 +815,13 @@ def main():
     if args.quick:
         args.concurrency, args.requests = [16], 10
     if args.decode:
-        report = bench_decode(sessions=args.sessions,
-                              gen_tokens=args.gen_tokens)
+        if args.speculative:
+            report = bench_decode_speculative(sessions=args.sessions,
+                                              gen_tokens=args.gen_tokens,
+                                              spec_k=args.spec_k)
+        else:
+            report = bench_decode(sessions=args.sessions,
+                                  gen_tokens=args.gen_tokens)
         if not args.no_train and "error" not in report:
             # the training side of the workload: gpt_mini fit step with
             # the XLA-cost-model FLOPs ledger (bench.py `transformer`) —

@@ -456,6 +456,47 @@ def test_decode_failover_with_shared_pages_and_fleetwide_close():
                 pass
 
 
+def test_decode_generate_op_full_stream_with_speculation():
+    """The multi-token "generate" wire op: the host runs the whole
+    greedy loop (so speculation's launch savings survive the wire
+    instead of paying one HTTP round-trip per token), and the router
+    keeps canonical history — appending only confirmed tokens, so a
+    later generate can omit ids entirely."""
+    server = ModelServer(_tiny_gpt(), port=0, replicas=1, warmup=False,
+                         decode_engine=DecodeEngine(
+                             _tiny_gpt(), n_pages=16, page_tokens=8,
+                             speculative=2, draft_net=_tiny_gpt())
+                         ).start()
+    router = FrontDoorRouter().start()
+    router.add_host(server.url)
+    prompt = [2, 5, 9]
+    ref8 = _ref_stream(prompt, 8)
+    try:
+        st, out, _ = _post(router.url, "/decode",
+                           {"op": "generate", "sid": "g1", "ids": prompt,
+                            "n_tokens": 6})
+        assert st == 200
+        # same-seeded draft -> full accepts, and still the exact stream
+        assert out["tokens"] == ref8[:6]
+        assert out["speculative"] is True
+        assert router._history["g1"] == prompt + ref8[:6]
+        # ids omitted: the router supplies its held history, and greedy
+        # determinism makes the continuation the 8-token stream's tail
+        st, out2, _ = _post(router.url, "/decode",
+                            {"op": "generate", "sid": "g1",
+                             "n_tokens": 2})
+        assert st == 200
+        assert out2["tokens"] == ref8[6:]
+        # an unknown session with no ids and no history is the client's
+        # error, not a routing failure
+        st, _, _hdrs = router.handle_decode(
+            {"op": "generate", "sid": "ghost", "n_tokens": 2}, "t")
+        assert st == 400
+    finally:
+        router.stop()
+        server.stop()
+
+
 def test_decode_step_unknown_session_404_and_bad_op_400():
     router = FrontDoorRouter().start()
     try:

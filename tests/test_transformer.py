@@ -12,6 +12,7 @@ TRANSFORMER receipt's budget gate.
 import json
 import os
 import sys
+import threading
 
 import numpy as np
 import pytest
@@ -250,6 +251,45 @@ class TestDecodeEngine:
         finally:
             eng.stop()
 
+    def test_extent_overflow_releases_pool_pages(self):
+        # a session that dies at the cache extent must hand its pages
+        # back immediately, not squat until close_session
+        net = _net(max_len=16)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0)
+        try:
+            eng.prefill("edge", _ids(16, seed=52))
+            assert eng.pool.pages_used > 0
+            with pytest.raises(ValueError):
+                eng.step("edge", 1)
+            assert eng.pool.pages_used == 0
+            # the host-side record survives for close_session bookkeeping
+            assert eng.close_session("edge") is True
+        finally:
+            eng.stop()
+
+    def test_final_step_skips_discarded_argmax(self, monkeypatch):
+        # generate() takes exactly one argmax per emitted token: the
+        # final step's logits are discarded, so no n+1'th call
+        net = _net()
+        prompt = _ids(6, seed=53)
+        refs = self._refs(net, {"a": prompt}, 4)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0)
+        try:
+            calls = {"n": 0}
+            real = np.argmax
+
+            def counting(*a, **kw):
+                calls["n"] += 1
+                return real(*a, **kw)
+
+            monkeypatch.setattr(np, "argmax", counting)
+            out = eng.generate("a", prompt, 4)
+            monkeypatch.setattr(np, "argmax", real)
+            assert out == refs["a"]
+            assert calls["n"] == 4
+        finally:
+            eng.stop()
+
 
 def _kv_leaves(ids, extent=32, heads=2, dh=2):
     """Synthetic pageable cache leaves for pool-only tests: one
@@ -348,6 +388,20 @@ class TestKVPoolPrefixSharing:
         assert p.drop("s1") is True
         assert p.describe()["store_pages"] == 2
 
+    def test_match_prefix_on_own_live_session_keeps_pages(self):
+        # a live session re-admitted over its OWN sealed pages (repeat
+        # wire-op generate, speculative resync): the new references must
+        # be taken before the old entry releases, or the match frees the
+        # very pages it adopted
+        p = KVPagePool(n_pages=8, page_tokens=4)
+        ids = _ids(9, seed=74)
+        p.put("a", 9, _kv_leaves(ids), ids=ids)   # 2 sealed + 1 tail
+        n, partial = p.match_prefix("a", ids)
+        assert n == 8
+        np.testing.assert_array_equal(partial[0],
+                                      _kv_leaves(ids)[0][:, :8])
+        assert p.describe()["store_pages"] == 2
+
     def test_put_without_ids_stays_dense_and_unshared(self):
         p = KVPagePool(n_pages=8, page_tokens=4)
         ids = _ids(8, seed=70)
@@ -356,6 +410,46 @@ class TestKVPoolPrefixSharing:
         d = p.describe()
         assert d["pages_used"] == 4 and d["shared_pages"] == 0
         assert p.match_prefix("s3", ids) == (0, None)
+
+
+class TestKVPoolTruncate:
+    """pool.truncate: the speculative-rollback primitive — drop fed
+    tokens past the accept point, refcount-safe for COW-shared pages."""
+
+    def test_mid_page_truncate_rebuilds_tail_and_frees_pages(self):
+        p = KVPagePool(n_pages=8, page_tokens=4)
+        ids = _ids(10, seed=71)
+        p.put("a", 10, _kv_leaves(ids), ids=ids)   # 2 sealed + 2-token tail
+        assert p.truncate("a", 6, others={1: np.array([6], np.int32)})
+        assert p.truncations == 1 and p.truncated_pages == 1
+        got = p.get("a")
+        ref = _kv_leaves(ids[:6])
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], np.array([6], np.int32))
+
+    def test_truncate_refcounted_shared_pages_survive(self):
+        p = KVPagePool(n_pages=8, page_tokens=4)
+        ids = _ids(8, seed=72)
+        p.put("a", 8, _kv_leaves(ids), ids=ids)
+        p.put("b", 8, _kv_leaves(ids), ids=ids)    # shares both pages
+        assert p.truncate("a", 4)
+        # page 2 left a's chain but b still refs it — COW-safe
+        assert p.describe()["store_pages"] == 2
+        np.testing.assert_array_equal(p.get("b")[0], _kv_leaves(ids)[0])
+        np.testing.assert_array_equal(p.get("a")[0],
+                                      _kv_leaves(ids[:4])[0])
+
+    def test_truncate_rejects_dense_grow_and_unknown(self):
+        p = KVPagePool(n_pages=8, page_tokens=4)
+        ids = _ids(8, seed=73)
+        p.put("d", 8, _kv_leaves(ids))            # dense: no ids
+        assert p.truncate("d", 4) is False        # caller re-prefills
+        assert p.truncate("ghost", 4) is False
+        p.put("s", 8, _kv_leaves(ids), ids=ids)
+        assert p.truncate("s", 9) is False        # can't grow
+        assert p.truncate("s", 0) is False        # below one token
+        assert p.truncate("s", 8) is True         # no-op at the frontier
+        assert p.truncations == 0                 # no-ops aren't counted
 
 
 class TestChunkedPrefillSharing:
@@ -442,6 +536,159 @@ class TestChunkedPrefillSharing:
             for sid, ids in prompts.items():
                 eng.generate(sid, ids, 3)
             assert eng.chunked_prefills >= 1 and eng.prefix_hits >= 2
+            delta = obs.compile_delta(snap)
+            assert delta["count"] == 0, delta
+        finally:
+            eng.stop()
+
+
+class TestSpeculativeDecode:
+    """PR 18: draft-propose / target-verify rounds are bit-identical to
+    plain greedy decode — acceptance is exact argmax match, the first
+    mismatch truncates the round — and the kill switch restores the
+    PR 16 path exactly."""
+
+    def test_all_accepted_with_identical_draft(self):
+        # a same-seeded draft has identical weights, so every proposal
+        # matches the target argmax: each round emits k+1 tokens
+        net = _net()
+        prompts = {f"g{i}": _ids(t, seed=100 + i)
+                   for i, t in enumerate([5, 9])}
+        refs = TestDecodeEngine()._refs(net, prompts, 8)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           speculative=3, draft_net=_net())
+        try:
+            for sid, ids in prompts.items():
+                assert eng.generate(sid, ids, 8) == refs[sid], sid
+            assert eng.spec_rejected == 0
+            assert eng.spec_accepted == eng.spec_proposed > 0
+            assert eng.spec_rounds == 4 and eng.decode_steps == 0
+            assert eng.describe()["spec_accept_tokens_per_step"] == 4.0
+        finally:
+            eng.stop()
+
+    def test_all_rejected_degrades_to_plain_steps(self):
+        # every proposal wrong: each round truncates at position 0 and
+        # emits exactly the one pending token — the plain-step rate —
+        # while the stream stays bit-identical
+        net = _net()
+        prompt = _ids(6, seed=110)
+        n = 6
+        refs = TestDecodeEngine()._refs(net, {"r": prompt}, n)["r"]
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           speculative=3, draft_net=_net())
+        try:
+            def wrong(sid, want, k):
+                idx = len(want) - len(prompt)
+                good = refs[idx] if idx < len(refs) else 0
+                return [(good + 1) % V] * k
+
+            eng._propose = wrong
+            assert eng.generate("r", prompt, n) == refs
+            assert eng.spec_accepted == 0
+            assert eng.spec_rounds == n - 1
+            assert eng.spec_rejected == eng.spec_proposed > 0
+            assert eng.decode_steps == 1   # only the final plain step
+        finally:
+            eng.stop()
+
+    def test_vocab_mismatch_raises_actionable(self):
+        bad = zoo.gpt_mini_draft(vocab_size=V + 1, width=16, n_layers=1,
+                                 n_heads=2, max_len=48)
+        with pytest.raises(ValueError, match="vocab"):
+            DecodeEngine(_net(), replicas=1, speculative=2, draft_net=bad)
+
+    def test_draft_extent_too_short_raises(self):
+        short = zoo.gpt_mini_draft(vocab_size=V, width=16, n_layers=1,
+                                   n_heads=2, max_len=16)
+        with pytest.raises(ValueError, match="extent"):
+            DecodeEngine(_net(), replicas=1, speculative=2,
+                         draft_net=short)
+
+    def test_explicit_speculative_without_draft_raises(self):
+        with pytest.raises(ValueError, match="draft_net"):
+            DecodeEngine(_net(), replicas=1, speculative=2)
+
+    def test_eviction_mid_stream_recovers_bit_identically(self):
+        # three concurrent speculative streams over a pool too small for
+        # all of them: eviction can land between (or inside) rounds, and
+        # the existing re-prefill recovery must keep every stream exact
+        net = _net()
+        prompts = {f"p{i}": _ids(t, seed=120 + i)
+                   for i, t in enumerate([6, 9, 12])}
+        refs = TestDecodeEngine()._refs(net, prompts, 6)
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           n_pages=6, page_tokens=4,
+                           speculative=2, draft_net=_net())
+        try:
+            streams, errs = {}, []
+
+            def run(sid):
+                try:
+                    streams[sid] = eng.generate(sid, prompts[sid], 6)
+                except Exception as e:   # pragma: no cover - failure mode
+                    errs.append(f"{sid}: {type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=run, args=(sid,))
+                       for sid in prompts]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert not errs
+            assert streams == refs
+            assert eng.pool.evictions > 0 and eng.reprefills > 0
+        finally:
+            eng.stop()
+
+    def test_kill_switch_env_restores_plain_path(self, monkeypatch):
+        # DL4J_TPU_SPECULATIVE_K=0 must restore the exact PR 16 decode
+        # path: no draft engine, untouched spec counters, plain step
+        # accounting
+        net = _net()
+        prompt = _ids(7, seed=130)
+        refs = TestDecodeEngine()._refs(net, {"k": prompt}, 5)["k"]
+        monkeypatch.setenv("DL4J_TPU_SPECULATIVE_K", "0")
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           draft_net=_net())
+        try:
+            assert eng.spec_k == 0 and eng._draft is None
+            assert eng.generate("k", prompt, 5) == refs
+            assert eng.spec_rounds == 0 and eng.spec_proposed == 0
+            assert eng.prefills == 1 and eng.decode_steps == 5
+            d = eng.describe()
+            assert d["spec_accept_tokens_per_step"] is None
+            assert d["speculative_k"] == 0
+        finally:
+            eng.stop()
+
+    def test_env_knob_enables_speculation(self, monkeypatch):
+        net = _net()
+        prompt = _ids(5, seed=131)
+        refs = TestDecodeEngine()._refs(net, {"e": prompt}, 4)["e"]
+        monkeypatch.setenv("DL4J_TPU_SPECULATIVE_K", "2")
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           draft_net=_net())
+        try:
+            assert eng.spec_k == 2 and eng._draft is not None
+            assert eng.generate("e", prompt, 4) == refs
+            assert eng.spec_rounds > 0
+        finally:
+            eng.stop()
+
+    def test_compile_count_flat_after_warm_with_speculation(self):
+        # the verify rungs and the draft's own ladder are all explicit
+        # warm rungs: speculative traffic must add no fresh compiles
+        from deeplearning4j_tpu.observability import metrics as obs
+        net = _net()
+        eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
+                           max_batch=4, speculative=3, draft_net=_net())
+        try:
+            assert eng.warm()
+            snap = obs.compile_snapshot()
+            for i, t in enumerate([5, 9, 13]):
+                eng.generate(f"w{i}", _ids(t, seed=140 + i), 6)
+            assert eng.spec_rounds > 0
             delta = obs.compile_delta(snap)
             assert delta["count"] == 0, delta
         finally:
@@ -535,4 +782,27 @@ class TestTransformerBudgetGate:
         path = os.path.join(_REPO, "TRANSFORMER_r02.json")
         if not os.path.exists(path):
             pytest.skip("no TRANSFORMER_r02.json receipt in the checkout")
+        assert check_budgets.main(["--bench", path]) == 0
+
+    def test_spec_bound_fails_below_floor(self):
+        # a speculative receipt whose rounds never beat plain stepping
+        # (accept/step == 1.0) must fail the r03 gate demonstrably
+        rep = self._good()
+        rep["spec_accept_tokens_per_step"] = 1.0
+        rep["spec_bit_identical"] = 1
+        violations = check_budgets.check_report(rep, self._section())
+        assert any("spec_accept_tokens_per_step" in v for v in violations)
+
+    def test_spec_bounds_skip_non_speculative_receipts(self):
+        # r02-style receipts carry no spec_ fields: the new bounds must
+        # skip, keeping the existing receipt green
+        assert check_budgets.check_report(self._good(),
+                                          self._section()) == []
+
+    def test_r03_receipt_if_present(self):
+        # r03 is the speculative-decoding receipt: chunking + sharing +
+        # speculation ALL on, same bit-identity oracle
+        path = os.path.join(_REPO, "TRANSFORMER_r03.json")
+        if not os.path.exists(path):
+            pytest.skip("no TRANSFORMER_r03.json receipt in the checkout")
         assert check_budgets.main(["--bench", path]) == 0
