@@ -64,12 +64,18 @@ __all__ = [
     "bump_incarnation", "new_trace_id", "stamp_run_marker", "TRACE_HEADER",
     "export_snapshot", "MetricsFederation", "SNAPSHOT_SCHEMA_VERSION",
     "rank_suffix", "push_snapshot", "HeartbeatPusher",
+    "SpanPushBuffer", "TraceStore", "TRACE_PUSH_SCHEMA_VERSION",
 ]
 
-#: the header /predict accepts and echoes; serve_bench generates them
+#: the header /predict and /decode accept and echo; serve_bench
+#: generates them
 TRACE_HEADER = "X-DL4J-Trace-Id"
 
 SNAPSHOT_SCHEMA_VERSION = 1
+
+#: wire schema of the span-batch payload riding the metrics snapshot
+#: under its "spans" key (see SpanPushBuffer.payload / TraceStore)
+TRACE_PUSH_SCHEMA_VERSION = 1
 
 
 # ---------------------------------------------------------------------------
@@ -212,13 +218,17 @@ def stamp_run_marker(kind: str) -> None:
 # snapshot wire format
 # ---------------------------------------------------------------------------
 
-def export_snapshot(registry=None, health: Optional[dict] = None) -> dict:
+def export_snapshot(registry=None, health: Optional[dict] = None,
+                    spans: Optional[dict] = None) -> dict:
     """Render a registry into the federation wire form: full fidelity
     (family kind/help, every sample's labels + suffix) plus the
     canonical exposition-escaped ``key`` per sample, so the aggregator
     merges and re-renders without re-deriving escaping. ``health`` is
     the pusher's self-reported readiness payload (e.g. the serving
-    batcher's ``healthy`` flag)."""
+    batcher's ``healthy`` flag). ``spans`` is a span-batch payload
+    (:meth:`SpanPushBuffer.payload`) riding the same push — aggregators
+    that predate it ignore the extra key (``MetricsFederation.ingest``
+    validates only ``families``)."""
     reg = registry if registry is not None else get_registry()
     fams = []
     for fam in reg.collect():
@@ -232,20 +242,24 @@ def export_snapshot(registry=None, health: Optional[dict] = None) -> dict:
                  "value": s.value}
                 for s in fam.samples],
         })
-    return {
+    out = {
         "schema": SNAPSHOT_SCHEMA_VERSION,
         "identity": get_identity().to_dict(),
         "time": time.time(),
         "families": fams,
         "health": dict(health or {}),
     }
+    if spans:
+        out["spans"] = spans
+    return out
 
 
 def push_snapshot(url: str, registry=None, health: Optional[dict] = None,
                   timeout: float = 5.0, *, attempts: int = 1,
                   backoff_initial_s: float = 0.2,
                   backoff_factor: float = 2.0, backoff_max_s: float = 5.0,
-                  jitter: float = 0.5, sleep_fn=time.sleep) -> dict:
+                  jitter: float = 0.5, sleep_fn=time.sleep,
+                  spans_fn=None) -> dict:
     """POST :func:`export_snapshot` to an aggregator's
     ``/api/metrics_push`` endpoint; returns the aggregator's reply.
 
@@ -255,14 +269,21 @@ def push_snapshot(url: str, registry=None, health: Optional[dict] = None,
     The snapshot is re-exported per attempt so the delivered heartbeat
     timestamp is fresh, not the first attempt's stale one. Jitter
     de-synchronizes a fleet whose workers all lost the same aggregator
-    at the same moment (the thundering-herd reconnect)."""
+    at the same moment (the thundering-herd reconnect).
+
+    ``spans_fn`` (no-arg -> span payload dict or None) is evaluated
+    ONCE, before the first attempt — a drain-style source
+    (:meth:`SpanPushBuffer.payload`) must not lose its batch to a retry,
+    so the same batch rides every attempt."""
     import random
     import urllib.request
     attempts = max(1, int(attempts))
     delay = backoff_initial_s
+    spans = spans_fn() if spans_fn is not None else None
     for attempt in range(attempts):
         try:
-            body = json.dumps(export_snapshot(registry, health)).encode()
+            body = json.dumps(
+                export_snapshot(registry, health, spans)).encode()
             req = urllib.request.Request(
                 url, data=body,
                 headers={"Content-Type": "application/json"})
@@ -294,16 +315,20 @@ class HeartbeatPusher:
 
     ``health_fn`` (no-arg -> dict) is re-evaluated per push so the
     delivered readiness payload is current, not construction-time.
+    ``spans_fn`` (no-arg -> span payload dict or None, e.g.
+    :meth:`SpanPushBuffer.payload`) rides each push under the
+    snapshot's ``spans`` key — the trace-stitching wire.
     """
 
     def __init__(self, url: str, interval_s: float = 2.0, *,
                  health_fn=None, registry=None, timeout: float = 5.0,
                  attempts: int = 3, backoff_initial_s: float = 0.2,
                  backoff_factor: float = 2.0, backoff_max_s: float = 2.0,
-                 jitter: float = 0.5):
+                 jitter: float = 0.5, spans_fn=None):
         self.url = url
         self.interval_s = float(interval_s)
         self.health_fn = health_fn
+        self.spans_fn = spans_fn
         self.registry = registry
         self.timeout = float(timeout)
         self.attempts = int(attempts)
@@ -328,7 +353,7 @@ class HeartbeatPusher:
                           backoff_initial_s=self.backoff_initial_s,
                           backoff_factor=self.backoff_factor,
                           backoff_max_s=self.backoff_max_s,
-                          jitter=self.jitter)
+                          jitter=self.jitter, spans_fn=self.spans_fn)
         except Exception as e:
             self.pushes_failed += 1
             self.last_error = f"{type(e).__name__}: {e}"
@@ -620,6 +645,350 @@ class MetricsFederation:
             "evict_after_factor": self.evict_after_factor,
             "auto_evicted_total": self.auto_evicted_total,
         }
+
+
+# ---------------------------------------------------------------------------
+# request-scoped trace stitching: span push + the aggregator-side store
+# ---------------------------------------------------------------------------
+
+class SpanPushBuffer:
+    """Bounded tracer sink collecting request-scoped spans (any span
+    whose attrs carry a ``trace_id`` or ``trace_ids``) for the
+    federation push channel.
+
+    Registered via :meth:`install` as a ``Tracer`` sink, so it sees
+    exactly the spans that survived the tracer's own sampling —
+    ``DL4J_TPU_TRACE_SAMPLE`` throttles the push wire for free, and
+    ``DL4J_TPU_TRACE=0`` silences it entirely (a disabled tracer records
+    nothing, so nothing reaches any sink). The buffer is a drain-on-push
+    ring: :meth:`payload` empties it into one schema-versioned batch
+    (the ``spans`` key of :func:`export_snapshot`); overflow between
+    pushes drops the OLDEST spans and counts them, so a stalled pusher
+    degrades to losing history, never memory."""
+
+    def __init__(self, tracer=None, capacity: int = 2048):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._spans: list = []
+        self.dropped = 0
+        self._tracer = None
+        if tracer is not None:
+            self.install(tracer)
+
+    # ----------------------------------------------------------------- sink
+    def _sink(self, span) -> None:
+        attrs = span.attrs
+        if not attrs or ("trace_id" not in attrs
+                         and "trace_ids" not in attrs):
+            return
+        with self._lock:
+            if len(self._spans) >= self.capacity:
+                del self._spans[0]
+                self.dropped += 1
+            self._spans.append(span)
+
+    def install(self, tracer=None) -> "SpanPushBuffer":
+        from deeplearning4j_tpu.observability.trace import get_tracer
+        t = tracer if tracer is not None else get_tracer()
+        if self._tracer is not None and self._tracer is not t:
+            self._tracer.remove_sink(self._sink)
+        self._tracer = t
+        t.add_sink(self._sink)
+        return self
+
+    def remove(self) -> None:
+        t, self._tracer = self._tracer, None
+        if t is not None:
+            t.remove_sink(self._sink)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    # ---------------------------------------------------------------- export
+    def drain(self) -> list:
+        with self._lock:
+            out, self._spans = self._spans, []
+        return out
+
+    def payload(self) -> Optional[dict]:
+        """Drain into one push batch, or None when there is nothing to
+        say (the snapshot then carries no ``spans`` key at all).
+        ``epoch_unix`` anchors the batch's monotonic ``ts_us`` stamps to
+        wall-clock so the TraceStore can lay spans from N processes on
+        one timeline."""
+        spans = self.drain()
+        if not spans:
+            return None
+        tracer = self._tracer
+        if tracer is not None:
+            epoch = tracer.epoch_unix()
+        else:
+            epoch = time.time() - time.perf_counter()
+        return {
+            "schema": TRACE_PUSH_SCHEMA_VERSION,
+            "epoch_unix": epoch,
+            "count": len(spans),
+            "dropped_total": self.dropped,
+            "spans": [s.to_dict() for s in spans],
+        }
+
+
+class TraceStore:
+    """Router/UIServer-side index of pushed spans by trace id, plus the
+    stitcher that renders ``GET /api/trace/<id>`` waterfalls.
+
+    Ingest side: :meth:`ingest_snapshot` pulls the ``spans`` batch out
+    of a pushed metrics snapshot (the ``/api/metrics_push`` hook) and
+    files every span under each trace id its attrs carry, stamped with
+    the pushing instance and rebased to approximate unix time via the
+    batch's ``epoch_unix`` anchor. The aggregator's OWN network spans
+    (the router's per-hop send/recv timestamps) enter directly through
+    :meth:`observe_network` — they are already on the local clock.
+
+    Bounds: at most ``max_traces`` trace ids (LRU by last update) and
+    ``max_spans_per_trace`` spans each (oldest dropped, counted) — a
+    busy fleet ages out history, never grows without bound.
+
+    Stitching (:meth:`waterfall`): per-process clocks only agree to
+    within NTP skew, so spans from each instance are rebased against
+    the router's send/recv anchors — for every proxied hop matched to
+    its server-side handler span (same ``host``/``server_url``, paired
+    in time order) the instance's clock offset is chosen so the handler
+    span sits centered inside the hop's [send, recv] window (the
+    classic RPC skew correction; the residual uncertainty is the
+    asymmetry of the two network legs). What the hop window does not
+    explain becomes explicit ``network`` segments — the queue_wait /
+    batch_assembly / device_compute / network waterfall the dashboard
+    renders."""
+
+    #: server-side spans that cover one whole proxied request — the
+    #: skew-correction partners of the router's network hops
+    HANDLER_SPANS = frozenset({"predict_handler", "decode_op"})
+    NETWORK_SPAN = "router_proxy"
+
+    def __init__(self, max_traces: int = 256,
+                 max_spans_per_trace: int = 512):
+        self.max_traces = max(1, int(max_traces))
+        self.max_spans_per_trace = max(1, int(max_spans_per_trace))
+        self._lock = threading.Lock()
+        self._traces: Dict[str, dict] = {}   # insertion order = LRU
+        self.ingested_spans = 0
+        self.dropped_spans = 0
+        self.evicted_traces = 0
+
+    # ---------------------------------------------------------------- ingest
+    def ingest_snapshot(self, snapshot: dict) -> int:
+        """File the ``spans`` batch of one pushed snapshot (if any);
+        returns the number of span records filed."""
+        if not isinstance(snapshot, dict):
+            return 0
+        payload = snapshot.get("spans")
+        if not isinstance(payload, dict):
+            return 0
+        ident = snapshot.get("identity") or {}
+        tag = ident.get("tag") or ident.get("instance") or "unknown"
+        return self.ingest_payload(str(tag), payload)
+
+    def ingest_payload(self, instance: str, payload: dict) -> int:
+        if payload.get("schema") != TRACE_PUSH_SCHEMA_VERSION:
+            return 0   # unknown schema: drop whole batch, never guess
+        try:
+            epoch = float(payload.get("epoch_unix"))
+        except (TypeError, ValueError):
+            return 0
+        n = 0
+        for sd in payload.get("spans", ()):
+            attrs = sd.get("attrs") or {}
+            ids = []
+            tid = attrs.get("trace_id")
+            if tid:
+                ids.append(str(tid))
+            for t in attrs.get("trace_ids") or ():
+                ids.append(str(t))
+            if not ids:
+                continue
+            try:
+                start = epoch + float(sd.get("ts_us", 0.0)) / 1e6
+                dur_ms = float(sd.get("dur_us", 0.0)) / 1e3
+            except (TypeError, ValueError):
+                continue
+            ent = {"name": sd.get("name") or "", "instance": instance,
+                   "start_unix": start, "dur_ms": dur_ms,
+                   "thread": sd.get("thread") or "", "attrs": attrs}
+            for t in dict.fromkeys(ids):
+                self._add(t, ent)
+                n += 1
+        return n
+
+    def observe_network(self, trace_id: str, *, host: str, path: str,
+                        send_unix: float, recv_unix: float,
+                        status: Optional[int] = None,
+                        instance: str = "router") -> None:
+        """Record one proxied hop's send/recv anchor (the aggregator's
+        own clock) — the timestamps every other instance's spans are
+        rebased against."""
+        self._add(str(trace_id), {
+            "name": self.NETWORK_SPAN, "instance": instance,
+            "start_unix": float(send_unix),
+            "dur_ms": max(0.0, (float(recv_unix) - float(send_unix))
+                          * 1e3),
+            "thread": "",
+            "attrs": {"trace_id": str(trace_id), "host": host,
+                      "path": path, "send_unix": float(send_unix),
+                      "recv_unix": float(recv_unix),
+                      **({"status": int(status)}
+                         if status is not None else {})},
+        })
+
+    def _add(self, trace_id: str, ent: dict) -> None:
+        with self._lock:
+            rec = self._traces.pop(trace_id, None)
+            if rec is None:
+                rec = {"spans": [], "dropped": 0}
+            self._traces[trace_id] = rec      # re-insert: LRU freshest
+            if len(rec["spans"]) >= self.max_spans_per_trace:
+                del rec["spans"][0]
+                rec["dropped"] += 1
+                self.dropped_spans += 1
+            rec["spans"].append(ent)
+            self.ingested_spans += 1
+            while len(self._traces) > self.max_traces:
+                oldest = next(iter(self._traces))
+                self._traces.pop(oldest)
+                self.evicted_traces += 1
+
+    # ----------------------------------------------------------------- views
+    def trace_ids(self) -> List[str]:
+        """Known trace ids, least recently updated first."""
+        with self._lock:
+            return list(self._traces)
+
+    def get(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            rec = self._traces.get(str(trace_id))
+            spans = list(rec["spans"]) if rec else []
+        return sorted(spans, key=lambda e: e["start_unix"])
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"traces": len(self._traces),
+                    "ingested_spans": self.ingested_spans,
+                    "dropped_spans": self.dropped_spans,
+                    "evicted_traces": self.evicted_traces,
+                    "max_traces": self.max_traces,
+                    "max_spans_per_trace": self.max_spans_per_trace}
+
+    # ------------------------------------------------------------- stitching
+    def waterfall(self, trace_id: str) -> dict:
+        """The stitched per-request view: every span of the trace,
+        clock-skew-rebased onto the aggregator's timeline, plus derived
+        ``network`` gap segments per proxied hop and a per-phase summary
+        (the ``/api/trace/<id>`` payload)."""
+        spans = self.get(trace_id)
+        if not spans:
+            return {"trace_id": str(trace_id), "found": False,
+                    "segments": []}
+        hops = [s for s in spans if s["name"] == self.NETWORK_SPAN]
+        offsets = self._clock_offsets(spans, hops)
+
+        segments = []
+        for s in spans:
+            off = offsets.get(s["instance"], 0.0)
+            segments.append({
+                "name": s["name"], "instance": s["instance"],
+                "start_unix": s["start_unix"] + off,
+                "dur_ms": s["dur_ms"], "thread": s["thread"],
+                "attrs": s["attrs"],
+            })
+        # derived network gaps: hop window minus its handler span
+        for hop, handler in self._match_hops(spans, hops):
+            send = hop["start_unix"]
+            recv = send + hop["dur_ms"] / 1e3
+            if handler is None:
+                continue
+            off = offsets.get(handler["instance"], 0.0)
+            h0 = handler["start_unix"] + off
+            h1 = h0 + handler["dur_ms"] / 1e3
+            out_ms = max(0.0, (h0 - send) * 1e3)
+            back_ms = max(0.0, (recv - h1) * 1e3)
+            host = hop["attrs"].get("host", "")
+            if out_ms > 0.0:
+                segments.append({"name": "network", "instance": "wire",
+                                 "start_unix": send, "dur_ms": out_ms,
+                                 "thread": "",
+                                 "attrs": {"direction": "request",
+                                           "host": host}})
+            if back_ms > 0.0:
+                segments.append({"name": "network", "instance": "wire",
+                                 "start_unix": h1, "dur_ms": back_ms,
+                                 "thread": "",
+                                 "attrs": {"direction": "response",
+                                           "host": host}})
+        segments.sort(key=lambda e: e["start_unix"])
+        t0 = segments[0]["start_unix"]
+        t1 = max(e["start_unix"] + e["dur_ms"] / 1e3 for e in segments)
+        for e in segments:
+            e["start_ms"] = round((e.pop("start_unix") - t0) * 1e3, 3)
+            e["dur_ms"] = round(e["dur_ms"], 3)
+        summary: Dict[str, float] = {}
+        for e in segments:
+            summary[e["name"]] = summary.get(e["name"], 0.0) + e["dur_ms"]
+        return {
+            "trace_id": str(trace_id),
+            "found": True,
+            "t0_unix": t0,
+            "total_ms": round((t1 - t0) * 1e3, 3),
+            "instances": sorted({e["instance"] for e in segments}),
+            "clock_offsets_ms": {k: round(v * 1e3, 3)
+                                 for k, v in offsets.items() if v},
+            "summary_ms": {k: round(v, 3)
+                           for k, v in sorted(summary.items())},
+            "segments": segments,
+        }
+
+    def _match_hops(self, spans, hops):
+        """Pair each network hop with the server-side handler span it
+        carried: same target (hop ``host`` == handler ``server_url``),
+        paired in time order — the k-th hop to a host matches the k-th
+        handler span that host reported for this trace."""
+        handlers: Dict[str, list] = {}
+        for s in spans:
+            if s["name"] in self.HANDLER_SPANS:
+                url = str(s["attrs"].get("server_url", ""))
+                handlers.setdefault(url.rstrip("/"), []).append(s)
+        for url in handlers:
+            handlers[url].sort(key=lambda e: e["start_unix"])
+        cursor: Dict[str, int] = {}
+        pairs = []
+        for hop in sorted(hops, key=lambda e: e["start_unix"]):
+            url = str(hop["attrs"].get("host", "")).rstrip("/")
+            cand = handlers.get(url, [])
+            i = cursor.get(url, 0)
+            pairs.append((hop, cand[i] if i < len(cand) else None))
+            cursor[url] = i + 1
+        return pairs
+
+    def _clock_offsets(self, spans, hops) -> Dict[str, float]:
+        """Per-instance clock correction (seconds to ADD to that
+        instance's timestamps): center each matched handler span inside
+        its hop's [send, recv] window and take the median correction
+        per instance. Instances with no matched hop keep offset 0 (they
+        already share the aggregator's clock, or there is nothing to
+        rebase against)."""
+        by_instance: Dict[str, list] = {}
+        for hop, handler in self._match_hops(spans, hops):
+            if handler is None:
+                continue
+            hop_center = hop["start_unix"] + hop["dur_ms"] / 2e3
+            h_center = handler["start_unix"] + handler["dur_ms"] / 2e3
+            by_instance.setdefault(handler["instance"], []).append(
+                hop_center - h_center)
+        out = {}
+        for inst, offs in by_instance.items():
+            offs.sort()
+            out[inst] = offs[len(offs) // 2]
+        return out
 
 
 def _family_value(snapshot: dict, name: str, agg=None) -> Optional[float]:

@@ -45,6 +45,22 @@ __all__ = [
 FLIGHT_SCHEMA_VERSION = 1
 
 
+def _ring_trace_ids(spans) -> list:
+    """Ordered unique trace ids riding the ring's span attrs (oldest
+    first) — the ``trace_ids`` field of the flight artifact, and the
+    join key that lets a post-mortem pull the same requests' stitched
+    waterfalls out of the router's TraceStore."""
+    seen: dict = {}
+    for s in spans:
+        attrs = s.attrs or {}
+        tid = attrs.get("trace_id")
+        if tid:
+            seen[str(tid)] = None
+        for t in attrs.get("trace_ids") or ():
+            seen[str(t)] = None
+    return list(seen)
+
+
 def _sanitize(tag: str) -> str:
     return "".join(c if (c.isalnum() or c in "-_.") else "_" for c in tag)
 
@@ -130,6 +146,9 @@ class FlightRecorder:
             "identity": ident.to_dict(),
             "exception": None,
             "events": events,
+            # the last-N request trace ids this process saw — join
+            # these against the aggregator's /api/trace/<id> store
+            "trace_ids": _ring_trace_ids(spans),
             "spans": [
                 {"name": s.name, "ts_us": s.ts_us, "dur_us": s.dur_us,
                  "thread": s.thread, "attrs": dict(s.attrs or {})}

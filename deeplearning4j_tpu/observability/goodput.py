@@ -173,6 +173,11 @@ class RunReport:
     # shard cursors when this run resumed a checkpoint saved under a
     # different fleet size; None for a same-topology run
     reshard: Optional[dict] = None
+    # SLO attainment summary (observability.slo): SLOEngine.report()
+    # stamped by ModelServer.stop() onto the serving drain report, so
+    # the receipt that says how fast the run was also says whether it
+    # honored its objectives; None outside the serving tier
+    slo: Optional[dict] = None
     # fleet identity (observability.distributed): which process/relaunch
     # produced this report — stamped by the ledger at finish time
     run_id: Optional[str] = None
@@ -206,6 +211,7 @@ class RunReport:
             "padding": self.padding,
             "trace_dropped_spans": self.trace_dropped_spans,
             "reshard": self.reshard,
+            "slo": self.slo,
             "run_id": self.run_id,
             "instance": self.instance,
             "incarnation": self.incarnation,

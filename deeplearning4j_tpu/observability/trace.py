@@ -223,6 +223,17 @@ class Tracer:
             self.dropped = 0
             self._dropped_by_name = {}
 
+    # ---------------------------------------------------------------- clocks
+    def epoch_unix(self) -> float:
+        """Approximate unix time of the tracer's perf_counter epoch —
+        the anchor that converts ``Span.ts_us`` (µs since epoch,
+        monotonic, per-process) into wall-clock time so spans pushed
+        from different processes can be laid on one timeline. Computed
+        fresh per call from the current clock pair; the residual error
+        is the clock-read skew (µs), far below the network gaps the
+        cross-process waterfall resolves."""
+        return time.time() - (time.perf_counter() - self._epoch)
+
     # ------------------------------------------------------------ drop stats
     def dropped_spans(self) -> dict:
         """Per-name dropped-span counts (ring eviction counts the

@@ -87,6 +87,8 @@ import numpy as np
 from jax import numpy as jnp
 
 from deeplearning4j_tpu.analysis.guards import guarded_by
+from deeplearning4j_tpu.observability.metrics import DEFAULT_BUCKETS
+from deeplearning4j_tpu.observability.trace import get_tracer as _get_tracer
 from deeplearning4j_tpu.serving.batcher import next_bucket
 from deeplearning4j_tpu.serving.fleet import ReplicaSet
 from deeplearning4j_tpu.serving.kvcache import KVPagePool
@@ -293,7 +295,8 @@ class DecodeSession:
 @guarded_by("_lock", "_sessions", "prefills", "decode_steps", "reprefills",
             "prefill_chunks", "chunked_prefills", "interleaved_prefills",
             "prefix_hits", "shared_tokens", "spec_rounds", "spec_proposed",
-            "spec_accepted", "spec_rejected")
+            "spec_accepted", "spec_rejected", "_itok_buckets", "_itok_sum",
+            "_itok_count")
 class DecodeEngine:
     """Sessionful autoregressive decode over a ``ReplicaSet``.
 
@@ -387,6 +390,16 @@ class DecodeEngine:
         self.spec_proposed = 0         # draft tokens proposed
         self.spec_accepted = 0         # proposals matching the target argmax
         self.spec_rejected = 0         # proposals truncated at a mismatch
+        # inter-token latency histogram (seconds): one observation per
+        # emitted token — plain steps observe their own wall time,
+        # speculative rounds amortize theirs over the tokens emitted.
+        # Surfaced through describe() into the
+        # dl4j_decode_inter_token_seconds family, so the p50/p99 the
+        # TRANSFORMER receipts pin is also scrapeable live.
+        self._itok_le = tuple(sorted(DEFAULT_BUCKETS))
+        self._itok_buckets = {b: 0 for b in self._itok_le}
+        self._itok_sum = 0.0
+        self._itok_count = 0
         # ---- speculative decode (PR 18): default OFF; k = 0 kills it
         explicit_spec = speculative is not None
         if speculative is None:
@@ -531,13 +544,41 @@ class DecodeEngine:
             leaves.append(arr)
         return leaves
 
-    def _run_prefill(self, sid: str, ids: List[int]) -> np.ndarray:
+    def _observe_inter_token(self, dt: float, n: int = 1) -> None:
+        """Fold ``n`` emitted tokens that took ``dt`` seconds each into
+        the inter-token histogram."""
+        with self._lock:
+            for b in self._itok_le:
+                if dt <= b:
+                    self._itok_buckets[b] += n
+                    break
+            self._itok_sum += dt * n
+            self._itok_count += n
+
+    @staticmethod
+    def _tid_attrs(trace_id, **attrs) -> dict:
+        """Span attrs with the request trace id attached when one rode
+        in — the key that makes the span stitchable (SpanPushBuffer
+        forwards only trace-carrying spans to the aggregator)."""
+        if trace_id:
+            attrs["trace_id"] = str(trace_id)
+        return attrs
+
+    def _run_prefill(self, sid: str, ids: List[int],
+                     trace_id: Optional[str] = None) -> np.ndarray:
         t = len(ids)
         if t < 1:
             raise ValueError("prefill needs at least one prompt token")
         if t > self.max_prompt:
             raise ValueError(f"prompt of {t} tokens exceeds the cache "
                              f"extent {self.max_prompt}")
+        with _get_tracer().span(
+                "decode_prefill",
+                **self._tid_attrs(trace_id, sid=sid, tokens=t)):
+            return self._run_prefill_inner(sid, ids, t, trace_id)
+
+    def _run_prefill_inner(self, sid: str, ids: List[int], t: int,
+                           trace_id: Optional[str]):
         ext = self._extend_seg()
         pos, leaves, logits = 0, None, None
         if self._sharing:
@@ -575,7 +616,8 @@ class DecodeEngine:
                 mask = np.zeros((1, bt), np.float32)
                 mask[0, :seg] = 1.0
                 feats = [x, mask] + list(leaves)
-            res = self._await(self.fleet.submit(feats, session=sid),
+            res = self._await(self.fleet.submit(feats, session=sid,
+                                                trace_id=trace_id),
                               sid, "prefill")
             logits, leaves = res[0], list(res[1:])
             pos += seg
@@ -590,19 +632,25 @@ class DecodeEngine:
                       ids=ids if self._sharing else None)
         return logits[0], leaves
 
-    def prefill(self, sid: str, ids: Sequence[int]) -> np.ndarray:
+    def prefill(self, sid: str, ids: Sequence[int],
+                trace_id: Optional[str] = None) -> np.ndarray:
         """Admit session ``sid`` with prompt token ids; returns the
-        next-token logits row [V]."""
+        next-token logits row [V]. ``trace_id`` (the request's
+        ``X-DL4J-Trace-Id``) rides the engine's spans and the batcher
+        tickets so the session's work stitches into the aggregator's
+        per-request waterfall."""
         ids = [int(i) for i in ids]
         with self._lock:
             self._sessions[sid] = DecodeSession(sid, ids)
             self.prefills += 1
-        return self._run_prefill(sid, ids)[0]
+        return self._run_prefill(sid, ids, trace_id=trace_id)[0]
 
-    def step(self, sid: str, token: int) -> np.ndarray:
+    def step(self, sid: str, token: int,
+             trace_id: Optional[str] = None) -> np.ndarray:
         """Feed one decoded token into session ``sid``; returns the
         next-token logits row [V]. Transparently re-prefills from token
         history when the pool evicted this session between steps."""
+        t_start = time.perf_counter()
         with self._lock:
             sess = self._sessions.get(sid)
         if sess is None:
@@ -617,13 +665,19 @@ class DecodeEngine:
         leaves = self.pool.get(sid)
         if leaves is None:
             # evicted between steps: recover from history — the one-shot
-            # re-prefill is bit-identical to the steps it replaces
+            # re-prefill is bit-identical to the steps it replaces (and
+            # carries the SAME trace id, so a stitched waterfall shows
+            # the recovery inline with the request that paid for it)
             with self._lock:
                 self.reprefills += 1
-            leaves = self._run_prefill(sid, sess.ids)[1]
+            leaves = self._run_prefill(sid, sess.ids, trace_id=trace_id)[1]
         x = self._one_hot([token], 1)
-        res = self._await(self.fleet.submit([x] + list(leaves),
-                                            session=sid), sid, "step")
+        with _get_tracer().span("decode_step",
+                                **self._tid_attrs(trace_id, sid=sid)):
+            res = self._await(self.fleet.submit([x] + list(leaves),
+                                                session=sid,
+                                                trace_id=trace_id),
+                              sid, "step")
         logits, new_leaves = res[0], res[1:]
         sess.ids.append(int(token))
         sess.last_step = time.time()
@@ -634,6 +688,7 @@ class DecodeEngine:
         # so shared prompt pages stay copy-on-write
         self.pool.put(sid, sess.tokens, new_leaves,
                       ids=sess.ids if self._sharing else None)
+        self._observe_inter_token(time.perf_counter() - t_start)
         return logits[0]
 
     # ---------------------------------------------------------- speculative
@@ -658,7 +713,8 @@ class DecodeEngine:
         del sess.ids[to_tokens:]
         return True
 
-    def _sync_logits(self, sid: str, want: List[int]) -> np.ndarray:
+    def _sync_logits(self, sid: str, want: List[int],
+                     trace_id: Optional[str] = None) -> np.ndarray:
         """Next-token logits with session ``sid``'s fed history equal to
         ``want`` — the draft-side resync between speculative rounds.
         Reuses the live session when its history is a prefix of ``want``
@@ -683,25 +739,27 @@ class DecodeEngine:
             if have is not None and len(have) < len(want):
                 logits = None
                 for t in want[len(have):]:
-                    logits = self.step(sid, t)
+                    logits = self.step(sid, t, trace_id=trace_id)
                 return logits
-        return self.prefill(sid, want)
+        return self.prefill(sid, want, trace_id=trace_id)
 
-    def _propose(self, sid: str, want: List[int], k: int) -> List[int]:
+    def _propose(self, sid: str, want: List[int], k: int,
+                 trace_id: Optional[str] = None) -> List[int]:
         """``k`` greedy draft proposals continuing ``want`` — runs on the
         draft engine (its own fleet/pool); the last proposal is left
         un-fed, the next round's resync settles it."""
         d = self._draft
-        logits = d._sync_logits(sid, want)
+        logits = d._sync_logits(sid, want, trace_id=trace_id)
         props: List[int] = []
         for _ in range(k):
             t = int(np.argmax(logits))
             props.append(t)
             if len(props) < k:
-                logits = d.step(sid, t)
+                logits = d.step(sid, t, trace_id=trace_id)
         return props
 
-    def _spec_round(self, sid: str, nxt: int, max_new: int):
+    def _spec_round(self, sid: str, nxt: int, max_new: int,
+                    trace_id: Optional[str] = None):
         """One draft-propose / target-verify round: the draft proposes
         ``k`` tokens continuing ``nxt``, the target verifies all of them
         in ONE batched verify forward, and exact argmax match decides
@@ -720,14 +778,15 @@ class DecodeEngine:
         k = min(self.spec_k, int(max_new), self.max_prompt - base - 1)
         if k < 1:
             return None
-        props = self._propose(sid, sess.ids + [int(nxt)], k)
+        props = self._propose(sid, sess.ids + [int(nxt)], k,
+                              trace_id=trace_id)
         leaves = self.pool.get(sid)
         if leaves is None:
             # evicted mid-round: the same bit-identical re-prefill
             # recovery as step()
             with self._lock:
                 self.reprefills += 1
-            leaves = self._run_prefill(sid, sess.ids)[1]
+            leaves = self._run_prefill(sid, sess.ids, trace_id=trace_id)[1]
         seq = [int(nxt)] + props
         cap = min(self.spec_k + 1, self.max_prompt - base)
         bt = next_bucket(len(seq), cap, self.min_prompt_bucket)
@@ -735,8 +794,13 @@ class DecodeEngine:
         mask = np.zeros((1, bt), np.float32)
         mask[0, :len(seq)] = 1.0
         # mask-first feats mark the verify (all-position-logits) variant
-        res = self._await(self.fleet.submit([mask, x] + list(leaves),
-                                            session=sid), sid, "verify")
+        with _get_tracer().span(
+                "decode_verify",
+                **self._tid_attrs(trace_id, sid=sid, proposed=k)):
+            res = self._await(self.fleet.submit([mask, x] + list(leaves),
+                                                session=sid,
+                                                trace_id=trace_id),
+                              sid, "verify")
         rows, new_leaves = res[0][0], list(res[1:])
         emitted = [int(nxt)]
         accepted = 0
@@ -775,16 +839,18 @@ class DecodeEngine:
         return emitted, nxt2
 
     def generate(self, sid: str, ids: Sequence[int], n_tokens: int,
-                 *, step_times: Optional[list] = None) -> List[int]:
+                 *, step_times: Optional[list] = None,
+                 trace_id: Optional[str] = None) -> List[int]:
         """Greedy decode: prefill then ``n_tokens`` argmax tokens —
         plain single-token steps, or draft-propose/target-verify rounds
         when speculation is on (same stream either way, bit-identical).
         Returns the generated ids; ``step_times`` (if given) collects
         per-token wall seconds — the inter-token latency sample stream
         (a speculative round's wall time is amortized over the tokens it
-        emitted)."""
+        emitted); ``trace_id`` rides every span and ticket the
+        generation dispatches."""
         n = int(n_tokens)
-        logits = self.prefill(sid, ids)
+        logits = self.prefill(sid, ids, trace_id=trace_id)
         out: List[int] = []
         if n <= 0:
             return out
@@ -793,17 +859,19 @@ class DecodeEngine:
             left = n - len(out)
             if self.spec_k and left >= 2:
                 t0 = time.perf_counter()
-                r = self._spec_round(sid, nxt, left - 1)
+                r = self._spec_round(sid, nxt, left - 1,
+                                     trace_id=trace_id)
                 if r is not None:
                     emitted, nxt = r
+                    dt = (time.perf_counter() - t0) / len(emitted)
+                    self._observe_inter_token(dt, n=len(emitted))
                     if step_times is not None:
-                        dt = (time.perf_counter() - t0) / len(emitted)
                         step_times.extend([dt] * len(emitted))
                     out.extend(emitted)
                     continue
             out.append(nxt)
             t0 = time.perf_counter()
-            logits = self.step(sid, nxt)
+            logits = self.step(sid, nxt, trace_id=trace_id)
             if step_times is not None:
                 step_times.append(time.perf_counter() - t0)
             if len(out) < n:
@@ -843,6 +911,14 @@ class DecodeEngine:
                  shared_tokens=self.shared_tokens,
                  prefill_chunk_tokens=self._chunk_tokens,
                  prefix_sharing=self._sharing)
+        with self._lock:
+            if self._itok_count:
+                d["inter_token_hist"] = {
+                    "buckets": {str(b): c
+                                for b, c in self._itok_buckets.items()},
+                    "sum": round(self._itok_sum, 6),
+                    "count": self._itok_count,
+                }
         steps = self.decode_steps + self.spec_rounds
         d.update(speculative_k=self.spec_k,
                  spec_rounds=self.spec_rounds,

@@ -350,6 +350,23 @@ def decode_metric_families(describe: dict, labels=None):
     fam("dl4j_decode_reprefills_total", "counter",
         "Evicted sessions re-admitted bit-identically from history",
         describe.get("reprefills"))
+    itok = describe.get("inter_token_hist")
+    if itok and itok.get("count"):
+        from deeplearning4j_tpu.observability.metrics import _fmt_value
+        hist = MetricFamily(
+            "dl4j_decode_inter_token_seconds", "histogram",
+            "Wall time between consecutive emitted tokens per decode "
+            "session (live p50/p99 source — the tail the chunked-"
+            "prefill/speculative levers move)")
+        cum = 0
+        for le, n in sorted(itok["buckets"].items(),
+                            key=lambda kv: float(kv[0])):
+            cum += int(n)
+            hist.add(cum, {**L, "le": _fmt_value(float(le))},
+                     suffix="_bucket")
+        hist.add(round(float(itok["sum"]), 6), L, suffix="_sum")
+        hist.add(int(itok["count"]), L, suffix="_count")
+        fams.append(hist)
     if describe.get("speculative_k"):
         fam("dl4j_decode_spec_rounds_total", "counter",
             "Speculative draft-propose/target-verify rounds run",

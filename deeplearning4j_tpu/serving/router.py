@@ -65,6 +65,14 @@ plane.
   ``"rollback"``) naming the rejected version and the metric delta
   that killed it. ``reinstate(url)`` lifts the quarantine after the
   host has been swapped back to good weights.
+- **Trace stitching + fleet SLOs** (OBSERVABILITY.md §Request tracing
+  & SLOs). Every proxied hop records its [send, recv] window on the
+  router's clock; backend hosts push their request-scoped span batches
+  over the same ``/api/metrics_push`` wire; ``GET /api/trace/<id>``
+  serves the clock-skew-rebased per-request waterfall stitched by
+  ``TraceStore``. An ``SLOEngine`` over the same federation rows
+  exposes ``dl4j_slo_*`` attainment / burn-rate / budget-remaining
+  gauges on the router's ``/metrics`` and ``/api/fleet``.
 
 The router never imports jax — it is a pure dispatch process, cheap
 enough to front accelerator hosts without stealing their cores.
@@ -77,6 +85,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -85,9 +94,11 @@ from urllib.parse import urlparse
 
 from deeplearning4j_tpu.analysis.guards import guarded_by
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability import slo as _obs_slo
 from deeplearning4j_tpu.observability.distributed import (HeartbeatPusher,
                                                           MetricsFederation,
                                                           TRACE_HEADER,
+                                                          TraceStore,
                                                           new_trace_id)
 
 __all__ = ["FrontDoorRouter", "HostHandle", "NoHostsError",
@@ -211,6 +222,19 @@ class FrontDoorRouter:
         #: auto-eviction threshold as a multiple of the federation's
         #: ``stale_after_s`` (mirrors MetricsFederation.health); None
         #: disables — stale hosts are then only skipped, never evicted
+        #: request-scoped span index (OBSERVABILITY.md §Request
+        #: tracing): hosts' pushed span batches land here via
+        #: /api/metrics_push, the router's own per-hop send/recv
+        #: anchors enter in _proxy, and GET /api/trace/<id> serves the
+        #: stitched waterfall. Internally locked.
+        self.trace_store = TraceStore()
+        #: fleet-level SLO engine fed from the SAME federation rows the
+        #: router routes by; its gauge families ride the router's
+        #: /metrics exposition and push_url heartbeats. Internally
+        #: locked.
+        self.slo_engine = _obs_slo.SLOEngine(_obs_slo.default_serving_slos(
+            p99_bound_ms=float(os.environ.get("DL4J_TPU_SLO_P99_MS",
+                                              "500"))))
         self.evict_after_factor = (None if evict_after_factor is None
                                    else float(evict_after_factor))
         if self.evict_after_factor is not None \
@@ -606,8 +630,13 @@ class FrontDoorRouter:
     def _proxy(self, h: HostHandle, path: str, body: bytes,
                trace_id: str):
         """One request/reply over the host's pooled connection. Raises
-        ``_HostDown`` on any connection-level failure."""
+        ``_HostDown`` on any connection-level failure. Every hop's
+        [send, recv] window lands in the trace store on the router's
+        own clock — the anchors the stitcher rebases every remote
+        instance's spans against (a dead hop records with no status:
+        the waterfall shows the attempt that failed over)."""
         conn = h.acquire()  # analysis: ok(C001) — pooled connection, not a lock; released/discarded below
+        send_unix = time.time()
         try:
             conn.request("POST", path, body,
                          {"Content-Type": "application/json",
@@ -616,9 +645,16 @@ class FrontDoorRouter:
             data = resp.read()
             retry_after = resp.getheader("Retry-After")
             h.release(conn)
+            self.trace_store.observe_network(
+                trace_id, host=h.base_url, path=path,
+                send_unix=send_unix, recv_unix=time.time(),
+                status=resp.status)
             return resp.status, data, retry_after
         except (OSError, http.client.HTTPException) as e:
             h.discard(conn)
+            self.trace_store.observe_network(
+                trace_id, host=h.base_url, path=path,
+                send_unix=send_unix, recv_unix=time.time())
             raise _HostDown(f"{h.base_url}: {type(e).__name__}: {e}")
 
     def _route(self, path: str, body: bytes, trace_id: str,
@@ -872,6 +908,11 @@ class FrontDoorRouter:
         payload = self.federation.fleet_payload()
         payload["routing"] = self.route_table()
         payload["router"] = self.describe()
+        # advance the SLO windows from the freshest federation rows
+        # before reporting — /api/fleet is the bench's polling surface
+        self.slo_engine.ingest_fed_rows(self.federation.health())
+        payload["slo"] = self.slo_engine.report()
+        payload["trace_store"] = self.trace_store.describe()
         return payload
 
     def _attach_registry_collector(self):
@@ -912,6 +953,11 @@ class FrontDoorRouter:
             fam("dl4j_router_rollbacks_total", "counter",
                 "Canary versions rolled back by their gates",
                 d["rollbacks_total"])
+            # fleet SLO gauges: every scrape/push folds the freshest
+            # federation counters into the sliding windows, then
+            # renders attainment / burn-rate / budget-remaining
+            self.slo_engine.ingest_fed_rows(self.federation.health())
+            fams.extend(self.slo_engine.families())
             return fams
 
         reg = _obs_metrics.get_registry()
@@ -946,6 +992,16 @@ class FrontDoorRouter:
                     self._json(obj, code)
                 elif self.path.startswith("/api/fleet"):
                     self._json(router.fleet_payload())
+                elif self.path.startswith("/api/trace"):
+                    tid = self.path[len("/api/trace"):].strip("/")
+                    tid = tid.split("?", 1)[0]
+                    if tid:
+                        wf = router.trace_store.waterfall(tid)
+                        self._json(wf, 200 if wf["found"] else 404)
+                    else:
+                        self._json({
+                            "traces": router.trace_store.trace_ids(),
+                            "store": router.trace_store.describe()})
                 elif self.path.startswith("/metrics"):
                     if _obs_metrics.wants_prometheus(
                             self.headers.get("Accept", ""), self.path):
@@ -980,8 +1036,11 @@ class FrontDoorRouter:
                         code, data, hdrs = router.handle_decode(
                             json.loads(body.decode()), trace_id)
                     elif self.path.startswith("/api/metrics_push"):
-                        tag = router.federation.ingest(
-                            json.loads(body.decode()))
+                        snap = json.loads(body.decode())
+                        tag = router.federation.ingest(snap)
+                        # same push, second consumer: any span batch
+                        # riding the snapshot lands in the trace store
+                        router.trace_store.ingest_snapshot(snap)
                         code, data, hdrs = 200, json.dumps(
                             {"ok": True, "instance": tag}).encode(), []
                     else:

@@ -51,6 +51,7 @@ import numpy as np
 
 from deeplearning4j_tpu.observability import goodput as _goodput
 from deeplearning4j_tpu.observability import metrics as _obs_metrics
+from deeplearning4j_tpu.observability import trace as _obs_trace
 from deeplearning4j_tpu.serving.batcher import (BatcherDeadError,
                                                 MicroBatcher, QueueFullError,
                                                 next_bucket)
@@ -65,6 +66,17 @@ _next_bucket = next_bucket  # back-compat alias (seed name)
 class DeadlineExceededError(RuntimeError):
     """The per-request deadline (``request_timeout_s``) expired before
     the device produced a result — mapped to HTTP 504."""
+
+
+class UnknownSessionError(KeyError):
+    """A decode op referenced a session this host does not hold and the
+    request carried no token history to recover it from — mapped to
+    HTTP 404 (the router retries elsewhere or surfaces it; a plain 400
+    would read as a malformed request rather than a routing miss)."""
+
+    def __str__(self):
+        # KeyError.__str__ repr()s its arg; error payloads want prose
+        return self.args[0] if self.args else ""
 
 
 class _ServingHTTPServer(ThreadingHTTPServer):
@@ -82,7 +94,8 @@ class ModelServer:
                  model_axis: str = "model", data_axis=None, tp_rules=None,
                  compile_cache_dir=None, aot_manifest=None,
                  tuning_report=None, decode_engine=None,
-                 push_url=None, push_interval_s: float = 2.0):
+                 push_url=None, push_interval_s: float = 2.0,
+                 slos=None):
         from deeplearning4j_tpu.compilecache import cache as _ccache
         # Cold-start engine (SERVING.md "Cold start & AOT"):
         # - compile_cache_dir (or $DL4J_TPU_COMPILE_CACHE) activates the
@@ -185,6 +198,23 @@ class ModelServer:
         self.push_url = push_url
         self.push_interval_s = float(push_interval_s)
         self._pusher = None
+        # request-scoped span push (observability.distributed): a
+        # bounded tracer sink drained into each heartbeat push, so the
+        # aggregator's TraceStore can stitch this host's handler /
+        # batcher / decode spans into per-request waterfalls. Built in
+        # start() only when push_url is set; DL4J_TPU_TRACE=0 and
+        # DL4J_TPU_TRACE_SAMPLE throttle it at the tracer.
+        self._span_push = None
+        # SLO engine (observability.slo): declared objectives evaluated
+        # over this host's own ServingStats — gauge families on the
+        # registry (scrape + federation push for free), and the
+        # attainment summary stamped onto the drain RunReport by stop().
+        from deeplearning4j_tpu.observability import slo as _slo
+        if slos is None:
+            slos = _slo.default_serving_slos(p99_bound_ms=float(
+                os.environ.get("DL4J_TPU_SLO_P99_MS", "500")))
+        self.slo_engine = _slo.SLOEngine(slos) if slos else None
+        self._slo_collector = None
         # Live reload (SERVING.md §Live reload): the published weight
         # version currently serving (0 = boot weights, never hot-swapped)
         # and the swap counter — both pushed to the federation plane so
@@ -615,31 +645,37 @@ class ModelServer:
                 else:
                     self._json({"error": "not found"}, 404)
 
-            def _decode_op(self, payload):
+            def _decode_op(self, payload, trace_id=None):
                 """Host half of the cross-host decode protocol: the
                 request always carries the session's full token history
                 (``ids``), so a ``step`` for a sid this host has never
                 seen — a router failover after another host died — is
                 answered by re-prefilling from that history first. The
                 re-prefill is bit-identical to the steps it replaces
-                (serving/decode.py), so the reply is too."""
+                (serving/decode.py), so the reply is too. ``trace_id``
+                threads through to the engine's prefill/step/verify
+                spans and batcher tickets. An unknown sid with no
+                history raises UnknownSessionError — HTTP 404, distinct
+                from the 400 a malformed op earns."""
                 eng = server.decode_engine
                 op = payload.get("op")
                 sid = payload["sid"]
                 if op == "prefill":
-                    logits = eng.prefill(sid, payload["ids"])
+                    logits = eng.prefill(sid, payload["ids"],
+                                         trace_id=trace_id)
                     return {"logits": np.asarray(logits).tolist()}
                 if op == "step":
                     recovered = False
                     if sid not in eng.sessions:
                         ids = payload.get("ids") or ()
                         if not ids:
-                            raise KeyError(
+                            raise UnknownSessionError(
                                 f"unknown decode session '{sid}' and no "
                                 "ids history to recover from")
-                        eng.prefill(sid, ids)
+                        eng.prefill(sid, ids, trace_id=trace_id)
                         recovered = True
-                    logits = eng.step(sid, int(payload["token"]))
+                    logits = eng.step(sid, int(payload["token"]),
+                                      trace_id=trace_id)
                     return {"logits": np.asarray(logits).tolist(),
                             "recovered": recovered}
                 if op == "generate":
@@ -653,7 +689,8 @@ class ModelServer:
                         raise KeyError(
                             f"decode generate for '{sid}' needs ids")
                     toks = eng.generate(sid, [int(i) for i in ids],
-                                        int(payload.get("n_tokens", 0)))
+                                        int(payload.get("n_tokens", 0)),
+                                        trace_id=trace_id)
                     return {"tokens": [int(t) for t in toks],
                             "speculative": bool(eng.spec_k)}
                 if op == "close":
@@ -674,11 +711,24 @@ class ModelServer:
                 trace_id = (self.headers.get(_dist.TRACE_HEADER)
                             or _dist.new_trace_id())
                 echo = ((_dist.TRACE_HEADER, trace_id),)
+                # one handler span per request, trace-tagged and
+                # carrying server_url — the span the aggregator's
+                # TraceStore centers inside the router's send/recv hop
+                # window to rebase this host's clock (error paths
+                # included: a failed request still explains its time)
+                with _obs_trace.get_tracer().span(
+                        "decode_op" if is_decode else "predict_handler",
+                        trace_id=trace_id, server_url=server.url):
+                    self._handle_post(is_decode, trace_id, echo)
+
+            def _handle_post(self, is_decode, trace_id, echo):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n).decode())
                     if is_decode:
-                        self._json(self._decode_op(payload), headers=echo)
+                        self._json(self._decode_op(payload,
+                                                   trace_id=trace_id),
+                                   headers=echo)
                         return
                     if "inputs" in payload:
                         out = server.predict([np.asarray(a) for a in
@@ -707,6 +757,11 @@ class ModelServer:
                                headers=echo)
                 except DeadlineExceededError as e:
                     self._json({"error": str(e)}, 504, headers=echo)
+                except UnknownSessionError as e:
+                    # routing miss, not a malformed request: the router
+                    # recovers by re-prefill elsewhere, so it is not
+                    # counted against this host's error budget
+                    self._json({"error": str(e)}, 404, headers=echo)
                 except Exception as e:  # surface as a 400, keep serving
                     server.stats.record_error()
                     self._json({"error": f"{type(e).__name__}: {e}"}, 400,
@@ -721,6 +776,7 @@ class ModelServer:
             shapes_fn=lambda: self.shapes_seen)
         self._attach_fleet_collector()
         self._attach_decode_collector()
+        self._attach_slo_collector()
         self._ledger = _goodput.start_run("serving", net=self.net)
         self._ledger.rebase_compile(compile0)
         if self.warmup_s is not None:
@@ -734,10 +790,15 @@ class ModelServer:
         if self.push_url:
             # worker-fleet -> router federation heartbeat: retry is ON
             # (attempts=3, jittered backoff) so a router restart costs
-            # one delayed push, not this host's scoreboard row
+            # one delayed push, not this host's scoreboard row.
+            # Trace-tagged spans ride the same pushes (SpanPushBuffer
+            # drains into the snapshot's "spans" key) so the router can
+            # stitch per-request waterfalls without a second wire.
+            self._span_push = _dist.SpanPushBuffer().install()
             self._pusher = _dist.HeartbeatPusher(
                 self.push_url, self.push_interval_s,
-                health_fn=self._push_health).start()
+                health_fn=self._push_health,
+                spans_fn=self._span_push.payload).start()
         return self
 
     def _push_health(self) -> dict:
@@ -844,6 +905,23 @@ class ModelServer:
         reg.register_collector(_collect)
         self._decode_collector = (reg, _collect)
 
+    def _attach_slo_collector(self):
+        """SLO gauge families on the unified registry. The collector
+        ingests a fresh stats snapshot per render, so every scrape (and
+        every federation push, which reads the same registry) advances
+        the sliding windows — scrape-driven evaluation, the standard
+        Prometheus shape."""
+        if self.slo_engine is None:
+            return
+
+        def _collect():
+            self.slo_engine.ingest(self.stats.snapshot(self.shapes_seen))
+            return self.slo_engine.families()
+
+        reg = _obs_metrics.get_registry()
+        reg.register_collector(_collect)
+        self._slo_collector = (reg, _collect)
+
     def stop(self):
         """Stop accepting, then drain: every accepted ticket completes
         before the device thread exits. Closes the serving goodput
@@ -851,6 +929,9 @@ class ModelServer:
         if self._pusher is not None:
             self._pusher.stop()
             self._pusher = None
+        if self._span_push is not None:
+            self._span_push.remove()
+            self._span_push = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -867,7 +948,16 @@ class ModelServer:
             reg, collect = self._decode_collector
             reg.unregister_collector(collect)
             self._decode_collector = None
+        if self._slo_collector is not None:
+            reg, collect = self._slo_collector
+            reg.unregister_collector(collect)
+            self._slo_collector = None
         ledger = getattr(self, "_ledger", None)
+        if ledger is not None and self.slo_engine is not None:
+            # final ingest + stamp: the drain report carries the run's
+            # SLO attainment next to its goodput numbers
+            self.slo_engine.ingest(self.stats.snapshot(self.shapes_seen))
+            ledger.annotate(slo=self.slo_engine.report())
         if ledger is not None and self.stats.first_reply_unix is not None:
             # time-to-first-reply from PROCESS start (kernel starttime):
             # imports + model build + compiles + warm-up, the whole cold
@@ -888,7 +978,7 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
           model_axis: str = "model", data_axis=None,
           tp_rules=None, compile_cache_dir=None, aot_manifest=None,
           tuning_report=None, decode_engine=None, push_url=None,
-          push_interval_s: float = 2.0) -> ModelServer:
+          push_interval_s: float = 2.0, slos=None) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
@@ -901,4 +991,5 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
                        aot_manifest=aot_manifest,
                        tuning_report=tuning_report,
                        decode_engine=decode_engine, push_url=push_url,
-                       push_interval_s=push_interval_s).start()
+                       push_interval_s=push_interval_s,
+                       slos=slos).start()

@@ -15,6 +15,10 @@ backed by any attached ``BaseStatsStorage``:
   Prometheus text with ``Accept: text/plain`` or ``?format=prometheus``)
 - ``GET /api/trace``                           Chrome trace-event JSON of
   the process-global span tracer (loadable in Perfetto)
+- ``GET /api/traces``                          trace ids with pushed
+  request-scoped spans (the waterfall panel's inventory)
+- ``GET /api/trace/<id>``                      stitched cross-process
+  waterfall for one request trace (OBSERVABILITY.md §Request tracing)
 
 Use::
 
@@ -102,6 +106,14 @@ select{margin-left:12px}
    <div id="fleettable"></div>
  </div>
 </div>
+<div class="row">
+ <div class="card" id="wfcard" style="display:none">
+   <h3>Request waterfall <select id="wfselect"></select>
+     <span id="wfmeta" class="label"></span></h3>
+   <svg id="wfsvg" style="height:auto"></svg>
+   <div id="wflegend" class="label"></div>
+ </div>
+</div>
 <script>
 const COLORS=["#1a73e8","#e8710a","#188038","#d93025","#9334e6","#12858d"];
 function esc(s){ return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -146,6 +158,7 @@ function workerSeries(u, field){
 }
 async function refresh(){
   await refreshFleet();   // fleet scoreboard lives without any session
+  await refreshWaterfall();  // so does the request-trace waterfall
   const sess = document.getElementById("session").value;
   if (!sess) return;
   const u = await (await fetch("/api/updates?session="+
@@ -283,6 +296,63 @@ async function refreshFleet(){
   });
   document.getElementById("fleettable").innerHTML = html;
 }
+async function refreshWaterfall(){
+  // stitched per-request waterfall: trace ids arrive with the hosts'
+  // span pushes (/api/traces inventory); /api/trace/<id> serves the
+  // clock-skew-rebased segment list (queue_wait / batch_assembly /
+  // device_compute / network) this card draws as one horizontal lane
+  // per segment on the request's own time axis
+  const card = document.getElementById("wfcard");
+  let ids = [];
+  try {
+    const t = await (await fetch("/api/traces")).json();
+    ids = (t.traces || []).slice().reverse();  // most recent first
+  } catch (e) { ids = []; }
+  if (!ids.length){ card.style.display = "none"; return; }
+  card.style.display = "";
+  const sel = document.getElementById("wfselect");
+  const cur = Array.from(sel.options).map(o=>o.value);
+  if (JSON.stringify(cur) !== JSON.stringify(ids)){
+    const v = sel.value;
+    sel.innerHTML = ids.map(x=>`<option>${esc(x)}</option>`).join("");
+    sel.value = ids.includes(v) ? v : ids[0];
+  }
+  const wf = await (await fetch("/api/trace/"+
+      encodeURIComponent(sel.value))).json();
+  if (!wf.found){ card.style.display = "none"; return; }
+  document.getElementById("wfmeta").textContent =
+    `(${(wf.instances||[]).join(", ")} · total ${wf.total_ms} ms)`;
+  const segs = wf.segments || [];
+  const el = document.getElementById("wfsvg");
+  const W = el.clientWidth || 760, LH = 18, P = 200, TP = 4;
+  const H = TP*2 + segs.length*LH + 16;
+  el.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  el.style.height = H + "px";
+  const total = Math.max(wf.total_ms, 1e-9);
+  const sx = ms=>P + (W - P - 10) * ms / total;
+  let html = "";
+  segs.forEach((s, i)=>{
+    const y = TP + i*LH;
+    html += `<text x="${P-6}" y="${y+LH/2+3}" font-size="9"`+
+      ` text-anchor="end">${esc(s.instance+" · "+s.name)}</text>`+
+      `<rect x="${sx(s.start_ms).toFixed(1)}" y="${y+2}"`+
+      ` width="${Math.max(sx(s.start_ms+s.dur_ms)-sx(s.start_ms),0.8)
+        .toFixed(1)}" height="${LH-5}"`+
+      ` fill="${spanColor(s.name)}" fill-opacity="0.85">`+
+      `<title>${esc(s.name)} ${s.dur_ms.toFixed(2)} ms `+
+      `(${esc(s.instance)})</title></rect>`;
+  });
+  html += `<text x="${P}" y="${H-2}" font-size="10" fill="#888">`+
+    `0 ms</text>`+
+    `<text x="${W-80}" y="${H-2}" font-size="10" fill="#888">`+
+    `${total.toFixed(1)} ms</text>`;
+  el.innerHTML = html;
+  document.getElementById("wflegend").innerHTML =
+    Object.entries(wf.summary_ms || {}).map(([n, ms])=>
+      `<span style="color:${spanColor(n)}">&#9632; ${esc(n)} `+
+      `${ms.toFixed(2)} ms</span>`).join(" &nbsp;");
+}
+document.getElementById("wfselect").onchange = refreshWaterfall;
 const TRACE_PALETTE=["#1f77b4","#ff7f0e","#2ca02c","#d93025","#9334e6",
   "#8c564b","#e377c2","#7f7f7f","#bcbd22","#12858d"];
 function spanColor(name){
@@ -588,6 +658,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(om.get_registry().snapshot())
         elif url.path == "/api/fleet":
             self._json(ui.federation.fleet_payload())
+        elif url.path == "/api/traces":
+            self._json({"traces": ui.trace_store.trace_ids(),
+                        "store": ui.trace_store.describe()})
+        elif url.path.startswith("/api/trace/"):
+            tid = url.path[len("/api/trace/"):].strip("/")
+            wf = ui.trace_store.waterfall(tid)
+            self._json(wf, 200 if wf.get("found") else 404)
         elif url.path == "/api/trace":
             from deeplearning4j_tpu.observability.trace import get_tracer
             self._json(get_tracer().to_chrome_trace())
@@ -611,6 +688,9 @@ class _Handler(BaseHTTPRequestHandler):
             payload = json.loads(self.rfile.read(n).decode())
             if path == "/api/metrics_push":
                 tag = ui.federation.ingest(payload)
+                # same push, second consumer: any request-scoped span
+                # batch riding the snapshot lands in the trace store
+                ui.trace_store.ingest_snapshot(payload)
                 self._json({"status": "ok", "instance": tag,
                             "instances": ui.federation.instance_count()})
             else:
@@ -640,8 +720,12 @@ class UIServer:
         # /api/metrics_push; /metrics re-exports the merged view and
         # /api/fleet serves the health scoreboard
         from deeplearning4j_tpu.observability.distributed import (
-            MetricsFederation)
+            MetricsFederation, TraceStore)
         self.federation = MetricsFederation()
+        # request-scoped span index: span batches riding the same
+        # /api/metrics_push wire land here; /api/trace/<id> serves the
+        # stitched waterfall the dashboard panel renders
+        self.trace_store = TraceStore()
         self.port = self._httpd.server_address[1]  # resolved if port=0
         self.host = host
         self._thread = threading.Thread(

@@ -275,6 +275,11 @@ def bench_serving(concurrencies=(1, 8, 64), requests_per_client=25,
         # the serving goodput ledger closed on drain: device-time share
         # and the bucket ladder's padding waste ride the results file
         report["run_report"] = server.run_report.to_dict()
+        # SLO attainment over the bench's own load — the engine's
+        # sliding windows closed with the drain, so the --out receipt
+        # carries attainment / burn-rate / budget-remaining per SLO
+        if report["run_report"].get("slo"):
+            report["slo"] = report["run_report"]["slo"]
 
     for c in concurrencies:
         a = report["serialized"][f"c{c}"].get("rows_per_sec")
@@ -302,6 +307,10 @@ def bench_serving(concurrencies=(1, 8, 64), requests_per_client=25,
             # ladder's wall time (check_budgets gates these)
             "cold_start_s": rr.get("cold_start_s"),
             "warmup_s": rr.get("warmup_s"),
+            # headline SLO: availability attainment over the bench load
+            "slo_availability": (((report.get("slo") or {}).get("slos")
+                                  or {}).get("availability")
+                                 or {}).get("attainment"),
         }
     return report
 

@@ -1,0 +1,366 @@
+"""SLO engine + span-push wire tests: burn-rate math pinned on an
+injectable clock, sliding windows, per-source counter deltas and
+resets, the SpanPushBuffer's sampling/bound behavior, TraceStore
+ingest bounds, the flight-recorder trace-id satellite, and a
+demonstrably failing ``slo`` budget bound through check_budgets."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.observability.distributed import (
+    TRACE_PUSH_SCHEMA_VERSION,
+    SpanPushBuffer,
+    TraceStore,
+)
+from deeplearning4j_tpu.observability.slo import (
+    DEFAULT_WINDOWS_S,
+    SLO,
+    SLOEngine,
+    default_serving_slos,
+)
+from deeplearning4j_tpu.observability.trace import Tracer
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+import check_budgets  # noqa: E402  (scripts/check_budgets.py)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _snap(requests, errors=0, timeouts=0, **extra):
+    return {"requests_total": requests, "errors_total": errors,
+            "timeouts_total": timeouts, **extra}
+
+
+# ----------------------------------------------------------- burn-rate math
+
+
+def test_availability_attainment_and_burn_rate_math():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=60.0)],
+                    windows=(60.0, 600.0), clock=clk)
+    eng.ingest(_snap(0))              # baseline sighting: no observation
+    clk.advance(10.0)
+    eng.ingest(_snap(99, errors=1))   # 99 good, 1 bad in the interval
+    ev = eng.evaluate()["availability"]["60s"]
+    assert ev["good"] == 99 and ev["total"] == 100
+    assert ev["attainment"] == pytest.approx(0.99)
+    # failing exactly at the objective burns budget at exactly 1x
+    assert ev["burn_rate"] == pytest.approx(1.0)
+    assert ev["budget_remaining"] == pytest.approx(0.0)
+
+
+def test_burn_rate_overspend_goes_negative():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.999, window_s=60.0)],
+                    windows=(60.0,), clock=clk)
+    eng.ingest(_snap(0))
+    clk.advance(1.0)
+    eng.ingest(_snap(199, errors=1))  # 0.5% failure vs 0.1% budget
+    ev = eng.evaluate()["availability"]["60s"]
+    assert ev["attainment"] == pytest.approx(0.995)
+    assert ev["burn_rate"] == pytest.approx(5.0)
+    assert ev["budget_remaining"] == pytest.approx(-4.0)
+
+
+def test_window_slides_observations_out():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=60.0)],
+                    windows=(60.0, 600.0), clock=clk)
+    eng.ingest(_snap(0))
+    clk.advance(5.0)
+    eng.ingest(_snap(50, errors=50))  # terrible interval
+    clk.advance(100.0)                # ...now older than the 60s window
+    ev = eng.evaluate()["availability"]
+    assert ev["60s"]["attainment"] is None       # unknown, not failing
+    assert ev["60s"]["burn_rate"] is None
+    assert ev["600s"]["attainment"] == pytest.approx(0.5)
+
+
+def test_counter_reset_restarts_deltas():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=60.0)],
+                    windows=(60.0,), clock=clk)
+    eng.ingest(_snap(100, errors=2))
+    clk.advance(1.0)
+    # the process restarted: counters went backwards — the new absolute
+    # value stands as the delta instead of a huge negative
+    eng.ingest(_snap(5, errors=0))
+    ev = eng.evaluate()["availability"]["60s"]
+    assert ev["good"] == 5 and ev["total"] == 5
+
+
+def test_sources_keep_independent_counter_state():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=60.0)],
+                    windows=(60.0,), clock=clk)
+    eng.ingest(_snap(1000), source="host0")
+    eng.ingest(_snap(10), source="host1")
+    clk.advance(1.0)
+    eng.ingest(_snap(1100, errors=0), source="host0")
+    eng.ingest(_snap(20, errors=10), source="host1")
+    ev = eng.evaluate()["availability"]["60s"]
+    # host0 contributed 100 good, host1 10 good + 10 bad — NOT the
+    # cross-contaminated garbage of differencing host1 against host0
+    assert ev["good"] == 110 and ev["total"] == 120
+
+
+def test_fed_rows_reach_nested_health_serving_slice():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=60.0)],
+                    windows=(60.0,), clock=clk)
+    row = {"instance": "host0",
+           "health": {"serving": _snap(0)}}
+    eng.ingest_fed_rows([row])
+    clk.advance(1.0)
+    row["health"]["serving"] = _snap(10, errors=0)
+    eng.ingest_fed_rows([row])
+    ev = eng.evaluate()["availability"]["60s"]
+    assert ev["good"] == 10 and ev["total"] == 10
+
+
+def test_threshold_slo_counts_time_slices():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("p99", metric="latency_p99_ms",
+                         objective=0.9, window_s=60.0, bound=100.0)],
+                    windows=(60.0,), clock=clk)
+    for _ in range(9):
+        eng.ingest({"latency_p99_ms": 50.0})
+        clk.advance(0.1)
+    eng.ingest({"latency_p99_ms": 250.0})
+    ev = eng.evaluate()["p99"]["60s"]
+    assert ev["good"] == 9 and ev["total"] == 10
+    assert ev["attainment"] == pytest.approx(0.9)
+    assert ev["burn_rate"] == pytest.approx(1.0)
+
+
+def test_latency_shorthand_resolves_nested_percentiles():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("p99", metric="latency_p99_ms",
+                         objective=0.5, window_s=60.0, bound=100.0)],
+                    windows=(60.0,), clock=clk)
+    # ServingStats.snapshot shape: percentiles nested under latency_ms
+    eng.ingest({"latency_ms": {"p99": 42.0}})
+    ev = eng.evaluate()["p99"]["60s"]
+    assert ev["good"] == 1 and ev["total"] == 1
+
+
+def test_objective_one_burns_infinitely_on_any_failure():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=1.0, window_s=60.0)],
+                    windows=(60.0,), clock=clk)
+    eng.ingest(_snap(0))
+    clk.advance(1.0)
+    eng.ingest(_snap(99, errors=1))
+    ev = eng.evaluate()["availability"]["60s"]
+    assert ev["burn_rate"] == float("inf")
+    assert ev["budget_remaining"] == -float("inf")
+
+
+def test_slo_declaration_validation():
+    with pytest.raises(ValueError):
+        SLO("bad", metric="availability", objective=0.0)
+    with pytest.raises(ValueError):
+        SLO("bad", metric="availability", objective=1.5)
+    with pytest.raises(ValueError):
+        SLO("bad", metric="latency_p99_ms", objective=0.9)  # no bound
+    with pytest.raises(ValueError):
+        SLOEngine([SLO("dup", metric="availability", objective=0.9),
+                   SLO("dup", metric="availability", objective=0.9)])
+    with pytest.raises(ValueError):
+        SLOEngine(default_serving_slos(), windows=())
+
+
+def test_report_headline_uses_closest_window():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=300.0)],
+                    windows=DEFAULT_WINDOWS_S, clock=clk)
+    eng.ingest(_snap(0))
+    clk.advance(1.0)
+    eng.ingest(_snap(100))
+    rep = eng.report()
+    head = rep["slos"]["availability"]
+    assert head["window_s"] == 300.0
+    assert head["attainment"] == pytest.approx(1.0)
+    assert head["burn_rate"] == pytest.approx(0.0)
+    assert "60s" in head["windows"] and "3600s" in head["windows"]
+
+
+def test_families_render_three_gauges_with_labels():
+    clk = FakeClock()
+    eng = SLOEngine([SLO("availability", metric="availability",
+                         objective=0.99, window_s=60.0)],
+                    windows=(60.0,), clock=clk)
+    assert eng.families() == []          # no data: no samples
+    eng.ingest(_snap(0))
+    clk.advance(1.0)
+    eng.ingest(_snap(10))
+    fams = {f.name: f for f in eng.families()}
+    assert set(fams) == {"dl4j_slo_attainment", "dl4j_slo_burn_rate",
+                         "dl4j_slo_budget_remaining"}
+    s = fams["dl4j_slo_attainment"].samples[0]
+    assert s.labels == {"slo": "availability", "window": "60s"}
+    assert s.value == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------ span push wire
+
+
+def test_span_push_buffer_keeps_only_traced_spans_and_bounds():
+    tr = Tracer()
+    buf = SpanPushBuffer(tracer=tr, capacity=3)
+    with tr.span("untraced"):
+        pass                              # no trace_id attr: not pushed
+    for i in range(5):
+        with tr.span("step", trace_id=f"t{i}"):
+            pass
+    assert len(buf) == 3                  # oldest dropped, counted
+    assert buf.dropped == 2
+    payload = buf.payload()
+    assert payload["schema"] == TRACE_PUSH_SCHEMA_VERSION
+    assert payload["count"] == 3
+    assert payload["dropped_total"] == 2
+    assert [s["attrs"]["trace_id"] for s in payload["spans"]] \
+        == ["t2", "t3", "t4"]
+    assert isinstance(payload["epoch_unix"], float)
+    assert len(buf) == 0                  # drained on push
+    assert buf.payload() is None          # nothing to say: no spans key
+    buf.remove()
+
+
+def test_span_push_buffer_sees_post_sampling_spans_only():
+    tr = Tracer(sample_every=4)
+    buf = SpanPushBuffer(tracer=tr, capacity=64)
+    for i in range(8):
+        with tr.span("step", trace_id="t"):
+            pass
+    # the tracer's own sampling throttles the push wire for free
+    assert len(buf) == 2
+    buf.remove()
+
+
+def test_span_push_buffer_silent_when_tracing_disabled():
+    tr = Tracer(enabled=False)            # DL4J_TPU_TRACE=0 semantics
+    buf = SpanPushBuffer(tracer=tr, capacity=64)
+    with tr.span("step", trace_id="t"):
+        pass
+    assert len(buf) == 0
+    assert buf.payload() is None
+    buf.remove()
+
+
+def test_trace_store_rejects_unknown_schema_and_bounds_growth():
+    store = TraceStore(max_traces=2, max_spans_per_trace=2)
+    bad = {"schema": 999, "epoch_unix": 0.0,
+           "spans": [{"name": "x", "ts_us": 0, "dur_us": 1,
+                      "attrs": {"trace_id": "t"}}]}
+    assert store.ingest_payload("host0", bad) == 0
+    good = dict(bad, schema=TRACE_PUSH_SCHEMA_VERSION)
+    for tid in ("a", "b", "c"):
+        for _ in range(3):
+            p = {"schema": TRACE_PUSH_SCHEMA_VERSION, "epoch_unix": 0.0,
+                 "spans": [{"name": "x", "ts_us": 0, "dur_us": 1,
+                            "attrs": {"trace_id": tid}}]}
+            assert store.ingest_payload("host0", p) == 1
+    d = store.describe()
+    assert d["traces"] == 2               # LRU evicted "a"
+    assert d["evicted_traces"] == 1
+    assert d["dropped_spans"] == 3        # per-trace ring dropped 1 each
+    assert store.get("a") == []
+    assert len(store.get("c")) == 2
+    assert store.ingest_payload("host0", good) == 1  # schema now right
+
+
+def test_flightrec_artifact_lists_recent_trace_ids(tmp_path):
+    from deeplearning4j_tpu.observability.flightrec import FlightRecorder
+    from deeplearning4j_tpu.observability.trace import (get_tracer,
+                                                        set_tracer)
+    tr = Tracer()
+    prev = set_tracer(tr)
+    rec = FlightRecorder(dir=str(tmp_path))
+    rec.install()
+    try:
+        with get_tracer().span("queue_wait", trace_ids=["t1", "t2"]):
+            pass
+        with get_tracer().span("decode_step", trace_id="t3"):
+            pass
+        with get_tracer().span("untraced"):
+            pass
+        with get_tracer().span("decode_step", trace_id="t1"):
+            pass
+        path = rec.flush("preempt")
+    finally:
+        rec.uninstall()
+        set_tracer(prev)
+    with open(path) as f:
+        doc = json.load(f)
+    # ordered-unique: the crash artifact names the requests in flight
+    assert doc["trace_ids"] == ["t1", "t2", "t3"]
+
+
+# ---------------------------------------------------------------- CI gating
+
+
+def test_slo_budget_section_gates_the_receipt_shape():
+    with open(os.path.join(_REPO, "BUDGETS.json")) as f:
+        budgets = json.load(f)
+    section = budgets["slo"]
+    good = {"config": "slo",
+            "stitched_instances": 3,
+            "waterfall_latency_gap_pct": 2.1,
+            "waterfall_network_segments": 12,
+            "failover_trace_stitched": 1,
+            "decode_bit_identical": 1,
+            "slo_availability_attainment": 1.0,
+            "slo_availability_burn_rate": 0.0}
+    assert check_budgets.check_report(good, section) == []
+
+
+def test_slo_budget_bound_demonstrably_fails():
+    with open(os.path.join(_REPO, "BUDGETS.json")) as f:
+        budgets = json.load(f)
+    section = budgets["slo"]
+    bad = {"config": "slo",
+           "stitched_instances": 1,              # nothing stitched
+           "waterfall_latency_gap_pct": 55.0,    # attribution way off
+           "waterfall_network_segments": 0,
+           "failover_trace_stitched": 0,
+           "decode_bit_identical": 1,
+           "slo_availability_attainment": 0.9,   # burning budget hard
+           "slo_availability_burn_rate": 100.0}
+    violations = check_budgets.check_report(bad, section)
+    assert len(violations) >= 5
+    text = "\n".join(violations)
+    assert "slo_availability_attainment" in text
+    assert "waterfall_latency_gap_pct" in text
+
+
+def test_committed_receipt_passes_the_gate(tmp_path):
+    receipt = os.path.join(_REPO, "TRACE_SLO_r01.json")
+    if not os.path.exists(receipt):
+        pytest.skip("TRACE_SLO_r01.json not present")
+    assert check_budgets.main(["--bench", receipt]) == 0

@@ -1,0 +1,318 @@
+"""Request-scoped trace stitching tests: trace-id echo on the decode
+wire's error paths (404 unknown-sid / 400 bad-op, direct and through
+the router), synthetic cross-instance stitching with deliberate clock
+skew (offset recovery + derived network gaps + failover recovery spans
+under one trace id), and the live push pipeline — a traced request
+through a real router lands in its TraceStore via the heartbeat span
+batch and comes back as a stitched ``/api/trace/<id>`` waterfall."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.observability.distributed import (
+    TRACE_HEADER,
+    TRACE_PUSH_SCHEMA_VERSION,
+    TraceStore,
+    new_trace_id,
+)
+from deeplearning4j_tpu.observability.metrics import (MetricsRegistry,
+                                                      set_registry)
+from deeplearning4j_tpu.observability.trace import Tracer, set_tracer
+from deeplearning4j_tpu.serving import (DecodeEngine, FrontDoorRouter,
+                                        ModelServer)
+
+
+@pytest.fixture()
+def fresh_obs():
+    """Fresh registry + tracer; process globals restored after."""
+    reg = MetricsRegistry()
+    prev_reg = set_registry(reg)
+    tr = Tracer(enabled=True)
+    prev_tr = set_tracer(tr)
+    try:
+        yield reg, tr
+    finally:
+        set_registry(prev_reg)
+        set_tracer(prev_tr)
+
+
+def _tiny_gpt():
+    from deeplearning4j_tpu.zoo import gpt_mini
+    return gpt_mini(vocab_size=13, width=16, n_layers=1, n_heads=2,
+                    max_len=32, max_cache_len=32)
+
+
+def _mlp():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(Dense(n_in=6, n_out=8, activation="relu"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(url, path, obj, headers=None, timeout=60.0):
+    """POST returning (status, json_body, headers) — error replies
+    (4xx/5xx) come back the same way instead of raising, because the
+    whole point here is asserting on THEIR headers."""
+    req = urllib.request.Request(
+        url.rstrip("/") + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ------------------------------------------------- wire echo: error paths
+
+
+def test_decode_error_paths_echo_trace_id(fresh_obs):
+    """The satellite contract: /decode error replies carry the client's
+    X-DL4J-Trace-Id exactly like successes do — a 404 or 400 you cannot
+    correlate to the request that earned it is an unexplained gap in
+    the waterfall."""
+    server = ModelServer(_tiny_gpt(), port=0, replicas=1, warmup=False,
+                         decode_engine=DecodeEngine(
+                             _tiny_gpt(), n_pages=16, page_tokens=8)
+                         ).start()
+    tid = new_trace_id()
+    hdr = {TRACE_HEADER: tid}
+    try:
+        # unknown sid, no ids history: 404, distinct from malformed 400
+        st, out, h = _post(server.url, "/decode",
+                           {"op": "step", "sid": "ghost", "token": 1},
+                           headers=hdr)
+        assert st == 404
+        assert h.get(TRACE_HEADER) == tid
+        assert "unknown decode session" in out["error"]
+
+        # malformed op: the client's error, echoed back to the client
+        st, out, h = _post(server.url, "/decode",
+                           {"op": "frobnicate", "sid": "s"}, headers=hdr)
+        assert st == 400 and h.get(TRACE_HEADER) == tid
+        assert "frobnicate" in out["error"]
+
+        # generate without ids: also a 400 with the echo
+        st, out, h = _post(server.url, "/decode",
+                           {"op": "generate", "sid": "g", "n_tokens": 2},
+                           headers=hdr)
+        assert st == 400 and h.get(TRACE_HEADER) == tid
+        assert "needs ids" in out["error"]
+
+        # success path still echoes, and a server with no client id
+        # mints one rather than replying unstitchable
+        st, _, h = _post(server.url, "/decode",
+                         {"op": "prefill", "sid": "s1", "ids": [1, 2]},
+                         headers=hdr)
+        assert st == 200 and h.get(TRACE_HEADER) == tid
+        st, _, h = _post(server.url, "/decode",
+                         {"op": "close", "sid": "s1"})
+        assert st == 200 and h.get(TRACE_HEADER)
+    finally:
+        server.stop()
+
+
+def test_router_decode_error_paths_echo_trace_id(fresh_obs):
+    """Same contract one hop out: errors proxied through (or raised by)
+    the FrontDoorRouter still carry the client's trace id."""
+    server = ModelServer(_tiny_gpt(), port=0, replicas=1, warmup=False,
+                         decode_engine=DecodeEngine(
+                             _tiny_gpt(), n_pages=16, page_tokens=8)
+                         ).start()
+    router = FrontDoorRouter().start()
+    router.add_host(server.url)
+    tid = new_trace_id()
+    hdr = {TRACE_HEADER: tid}
+    try:
+        st, out, h = _post(router.url, "/decode",
+                           {"op": "step", "sid": "ghost", "token": 1},
+                           headers=hdr)
+        assert st == 404 and h.get(TRACE_HEADER) == tid
+        st, out, h = _post(router.url, "/decode",
+                           {"op": "frobnicate", "sid": "s"}, headers=hdr)
+        assert st == 400 and h.get(TRACE_HEADER) == tid
+        # the router-side 400 (generate, no ids, no held history) too
+        st, out, h = _post(router.url, "/decode",
+                           {"op": "generate", "sid": "ghost2",
+                            "n_tokens": 2}, headers=hdr)
+        assert st == 400 and h.get(TRACE_HEADER) == tid
+    finally:
+        router.stop()
+        server.stop()
+
+
+# ------------------------------------------- synthetic stitching math
+
+
+def _handler_payload(epoch, spans):
+    return {"schema": TRACE_PUSH_SCHEMA_VERSION, "epoch_unix": epoch,
+            "count": len(spans), "dropped_total": 0, "spans": spans}
+
+
+def _span(name, ts_s, dur_ms, **attrs):
+    return {"name": name, "ts_us": ts_s * 1e6, "dur_us": dur_ms * 1e3,
+            "thread": "t", "attrs": attrs}
+
+
+def test_waterfall_recovers_clock_skew_and_network_gaps():
+    """Hand-built two-host trace with deliberate clock skew: hostA's
+    clock reads 5s fast, hostB's 2s slow. The stitcher must rebase both
+    onto the router's send/recv anchors (median hop-center correction),
+    rebase each host's inner spans by the same offset, and turn the
+    unexplained hop-window remainder into explicit network segments."""
+    store = TraceStore()
+    tid = "deadbeefcafe0001"
+    # router's own clock: hop A [1000.0, 1000.1], hop B [1000.2, 1000.32]
+    store.observe_network(tid, host="http://a:1/", path="/decode",
+                          send_unix=1000.0, recv_unix=1000.1, status=200)
+    store.observe_network(tid, host="http://b:2", path="/decode",
+                          send_unix=1000.2, recv_unix=1000.32, status=200)
+    # hostA pushes on its own clock, 5s ahead of the router: a handler
+    # span truly centered in hop A's window plus a device_compute child
+    store.ingest_payload("hostA", _handler_payload(1005.0, [
+        _span("decode_op", 0.01, 80.0, trace_id=tid,
+              server_url="http://a:1"),
+        _span("device_compute", 0.02, 40.0, trace_id=tid),
+    ]))
+    # hostB (the failover survivor) is 2s slow; its re-prefill recovery
+    # span rides the SAME trace id — the failed-over tail stays stitched
+    store.ingest_payload("hostB", _handler_payload(998.0, [
+        _span("decode_op", 0.22, 80.0, trace_id=tid,
+              server_url="http://b:2"),
+        _span("decode_prefill", 0.23, 30.0, trace_id=tid),
+    ]))
+
+    wf = store.waterfall(tid)
+    assert wf["found"] is True
+    assert set(wf["instances"]) == {"router", "hostA", "hostB", "wire"}
+    # hop A center 1000.05 vs hostA handler center 1005.05 -> -5000ms;
+    # hop B center 1000.26 vs hostB handler center 998.26 -> +2000ms
+    assert wf["clock_offsets_ms"]["hostA"] == pytest.approx(-5000.0,
+                                                            abs=0.01)
+    assert wf["clock_offsets_ms"]["hostB"] == pytest.approx(2000.0,
+                                                            abs=0.01)
+    # derived gaps: hop A 100ms window - 80ms handler = 10ms each leg,
+    # hop B 120ms - 80ms = 20ms each leg => 60ms of explicit wire time
+    net = [s for s in wf["segments"] if s["name"] == "network"]
+    assert len(net) == 4
+    assert all(s["instance"] == "wire" for s in net)
+    assert {s["attrs"]["direction"] for s in net} \
+        == {"request", "response"}
+    assert wf["summary_ms"]["network"] == pytest.approx(60.0, abs=0.1)
+    # the rebased inner span sits inside its hop's window, not 5s away
+    dev = next(s for s in wf["segments"] if s["name"] == "device_compute")
+    assert 0.0 <= dev["start_ms"] <= 100.0
+    # recovery prefill from the survivor is part of this trace's story
+    assert any(s["name"] == "decode_prefill" and s["instance"] == "hostB"
+               for s in wf["segments"])
+    # the whole request: first anchor at 0, total spans the last recv
+    assert wf["segments"][0]["start_ms"] == 0.0
+    assert wf["total_ms"] == pytest.approx(320.0, abs=0.1)
+    # an id nobody pushed is found=False (the HTTP layer's 404)
+    assert store.waterfall("0000000000000000")["found"] is False
+
+
+# ------------------------------------------------- live push pipeline
+
+
+def test_traced_predict_stitches_in_router_store(fresh_obs):
+    """End to end, in-process: a traced /predict through a real router
+    + host. The host's span batch rides its heartbeat push into the
+    router's TraceStore; GET /api/trace/<id> then renders a waterfall
+    whose segments carry BOTH the router's hop and the host's handler
+    span under the one client-minted trace id."""
+    router = FrontDoorRouter().start()
+    server = ModelServer(_mlp(), port=0, replicas=1, warmup=False,
+                         max_batch=4,
+                         push_url=router.url.rstrip("/")
+                         + "/api/metrics_push",
+                         push_interval_s=0.2).start()
+    try:
+        router.add_host(server.url)
+        tid = new_trace_id()
+        st, out, h = _post(router.url, "/predict",
+                           {"features": [[0.1] * 6]},
+                           headers={TRACE_HEADER: tid})
+        assert st == 200 and h.get(TRACE_HEADER) == tid
+        assert len(out["predictions"]) == 1
+
+        # the hop is recorded synchronously; the handler span arrives
+        # with the next heartbeat push
+        deadline = time.time() + 15.0
+        wf = None
+        while time.time() < deadline:
+            st, wf = _get(router.url.rstrip("/") + "/api/trace/" + tid)
+            assert st == 200 and wf["found"] is True
+            if any(s["name"] == "predict_handler"
+                   for s in wf["segments"]):
+                break
+            time.sleep(0.2)
+        names = {s["name"] for s in wf["segments"]}
+        assert "router_proxy" in names      # the router's own anchor
+        assert "predict_handler" in names   # pushed by the host
+        assert len(wf["instances"]) >= 2
+        assert "predict_handler" in wf["summary_ms"]
+        # the trace index lists it too
+        st, listing = _get(router.url.rstrip("/") + "/api/trace")
+        assert tid in listing["traces"]
+        assert listing["store"]["traces"] >= 1
+        # unknown ids 404 instead of pretending
+        try:
+            _get(router.url.rstrip("/") + "/api/trace/ffffffffffffffff")
+            assert False, "unknown trace id must 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        router.stop()
+        server.stop()
+
+
+def test_ui_server_ingests_pushed_spans_and_serves_waterfall(fresh_obs):
+    """The dashboard aggregator half: a hand-built snapshot push to the
+    UIServer's /api/metrics_push files spans in ITS TraceStore, served
+    back via /api/traces + /api/trace/<id>."""
+    from deeplearning4j_tpu.ui import UIServer
+    server = UIServer(port=0)
+    tid = "feedfacefeedface"
+    try:
+        snap = {"schema": 1,
+                "identity": {"tag": "host7"},
+                "families": [],
+                "spans": _handler_payload(1000.0, [
+                    _span("decode_op", 0.01, 50.0, trace_id=tid,
+                          server_url="http://h:1"),
+                    _span("queue_wait", 0.012, 5.0, trace_ids=[tid]),
+                ])}
+        req = urllib.request.Request(
+            server.url.rstrip("/") + "/api/metrics_push",
+            data=json.dumps(snap).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+        st, listing = _get(server.url.rstrip("/") + "/api/traces")
+        assert st == 200 and tid in listing["traces"]
+        st, wf = _get(server.url.rstrip("/") + "/api/trace/" + tid)
+        assert st == 200 and wf["found"] is True
+        assert {s["name"] for s in wf["segments"]} \
+            == {"decode_op", "queue_wait"}
+        assert wf["instances"] == ["host7"]
+        try:
+            _get(server.url.rstrip("/") + "/api/trace/none")
+            assert False, "unknown trace id must 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.stop()

@@ -578,7 +578,7 @@ class TestSpeculativeDecode:
         eng = DecodeEngine(net, replicas=1, batch_window_ms=1.0,
                            speculative=3, draft_net=_net())
         try:
-            def wrong(sid, want, k):
+            def wrong(sid, want, k, trace_id=None):
                 idx = len(want) - len(prompt)
                 good = refs[idx] if idx < len(refs) else 0
                 return [(good + 1) % V] * k
