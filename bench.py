@@ -405,6 +405,84 @@ def bench_lockcheck_overhead(batch: int = 1024, n_batches: int = 32,
     }
 
 
+def bench_sched_overhead(rows: int = 4, pairs: int = 2000,
+                         trials: int = 5) -> dict:
+    """Scheduling-core overhead guard (SERVING.md §Traffic engine):
+    in-process ``ModelServer.predict`` round trips with the default
+    ``SchedulingCore`` on vs ``scheduler=False`` — the legacy
+    header-less path, the one every existing client rides. The
+    admission fast path costs ~2us against a ~600us predict round
+    trip, so the signal is small and the measurement design is the
+    whole problem: ONE server toggles ``fleet.scheduler`` between
+    arms (identical process, jit cache, device thread — nothing
+    differs but the admission branch) and the arms alternate EVERY
+    CALL in ABBA order, so the condvar round trip's second-scale OS
+    drift and any order bias cancel at the finest grain. Each trial
+    reports median(paired diffs)/median(off) — robust to the
+    carrier's heavy wakeup-latency tail — and the gated figure is
+    the mean over trials. An A/A control trial (both arms scheduler
+    off) is reported alongside so a noisy run is visible as such.
+    The acceptance bar is < 3%."""
+    from deeplearning4j_tpu import zoo
+    from deeplearning4j_tpu.scheduling.core import SchedulingCore
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, 784)).astype(np.float32)
+    net = zoo.mnist_mlp()
+    net.init(seed=5)
+    srv = ModelServer(net, warmup=False, batch_window_ms=0.0,
+                      scheduler=False)
+    np.asarray(srv.predict(x))            # warm-up: compile
+    sched = SchedulingCore()              # default: no quotas
+
+    def call(arm):
+        srv.fleet.scheduler = arm
+        t0 = time.perf_counter()
+        srv.predict(x)
+        return time.perf_counter() - t0
+
+    def trial(arm_a, arm_b, n):
+        diffs, offs = [], []
+        for p in range(n):
+            if p % 2 == 0:                # ABBA: order bias cancels
+                o = call(arm_a)
+                b = call(arm_b)
+            else:
+                b = call(arm_b)
+                o = call(arm_a)
+            diffs.append(b - o)
+            offs.append(o)
+        diffs.sort()
+        offs.sort()
+        med_off = offs[len(offs) // 2]
+        return diffs[len(diffs) // 2] / med_off * 100.0, med_off
+
+    try:
+        for _ in range(50):               # both arms warm
+            call(None)
+            call(sched)
+        aa_pct, _ = trial(None, None, pairs)
+        trial_pcts, med_offs = [], []
+        for _ in range(trials):
+            pct, med_off = trial(None, sched, pairs)
+            trial_pcts.append(pct)
+            med_offs.append(med_off)
+    finally:
+        srv.stop()
+    overhead_pct = sum(trial_pcts) / len(trial_pcts)
+    return {
+        "config": "sched_overhead",
+        "rows": rows, "pairs_per_trial": pairs, "trials": trials,
+        "predict_median_us_sched_off": round(
+            sum(med_offs) / len(med_offs) * 1e6, 1),
+        "aa_control_pct": round(aa_pct, 3),
+        "overhead_pct_trials": [round(p, 3) for p in trial_pcts],
+        "overhead_pct": round(overhead_pct, 3),
+        "overhead_ok": overhead_pct < 3.0,
+    }
+
+
 def bench_input_pipeline(batch: int = 1024, n_batches: int = 32,
                          epochs: int = 4) -> dict:
     """Input-pipeline round: full ``net.fit`` steps/sec and records/sec
@@ -490,6 +568,8 @@ def run_config(name: str) -> dict:
         return bench_identity_overhead()
     if name == "lockcheck_overhead":
         return bench_lockcheck_overhead()
+    if name == "sched_overhead":
+        return bench_sched_overhead()
     if name == "input_pipeline":
         return bench_input_pipeline()
     if name == "mnist_mlp":
@@ -654,7 +734,8 @@ def _timed(fn) -> float:
 _CONFIGS = ("mnist_mlp", "lenet", "resnet50", "char_rnn", "char_rnn_b256",
             "transformer", "serving", "decode", "speculative", "host_loop",
             "trace_overhead", "goodput_overhead", "identity_overhead",
-            "lockcheck_overhead", "input_pipeline", "mixed_precision")
+            "lockcheck_overhead", "sched_overhead", "input_pipeline",
+            "mixed_precision")
 
 
 def main():

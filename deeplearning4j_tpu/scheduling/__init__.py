@@ -1,0 +1,49 @@
+"""SLO-aware traffic engine (SERVING.md §Traffic engine).
+
+One scheduling brain for the four queue disciplines that grew
+independently across the serving tier:
+
+- batcher admission        (serving/batcher.py — ticket queue ordering)
+- fleet replica routing    (serving/fleet.py — global admission)
+- decode session scheduling (serving/decode.py — per-op class threading)
+- router host-picking      (serving/router.py — front-door admission)
+
+``core.SchedulingCore`` is that brain: admission classes (strict
+priority interactive > batch > best_effort), per-tenant token-bucket
+quotas, and deadline-aware shedding that degrades batch traffic first
+under the existing derived-Retry-After backpressure. Requests carry
+``X-DL4J-Tenant`` / ``X-DL4J-Priority`` / ``X-DL4J-Deadline-Ms``
+headers end to end, echoed like the trace id.
+
+``autoscaler.Autoscaler`` closes the loop: it watches the live
+federation gauges (queue depth, retry_after_s, SLO burn rate) and
+actuates through seams that already exist — ``ReplicaSet``
+drain/restart within a host, launcher spawn + router host-add across
+hosts — with hysteresis, cooldowns and min/max bounds so it never
+flaps.
+
+``loadgen`` is the open-loop, trace-driven arrival generator behind
+``scripts/traffic_bench.py`` (seeded diurnal ramps, flash crowds,
+heavy-tailed sizes, mixed tenants/classes) — the harness that produces
+the budget-gated ``TRAFFIC_r01.json`` receipt.
+"""
+
+from deeplearning4j_tpu.scheduling.autoscaler import (  # noqa: F401
+    Autoscaler, ReplicaSetActuator, fleet_signals)
+from deeplearning4j_tpu.scheduling.core import (  # noqa: F401
+    BATCH, BEST_EFFORT, CLASSES, DEADLINE_HEADER, INTERACTIVE, PRIORITY,
+    PRIORITY_HEADER, SCHED_HEADERS, SHED_CLASS_HEADER, SchedulingCore,
+    ShedError, TENANT_HEADER, TokenBucket, build_sched_headers,
+    normalize_class, parse_sched_headers)
+from deeplearning4j_tpu.scheduling.loadgen import (  # noqa: F401
+    Arrival, OpenLoopRunner, TrafficModel, attainment)
+
+__all__ = [
+    "SchedulingCore", "ShedError", "TokenBucket", "normalize_class",
+    "parse_sched_headers", "build_sched_headers",
+    "CLASSES", "PRIORITY", "INTERACTIVE", "BATCH", "BEST_EFFORT",
+    "TENANT_HEADER", "PRIORITY_HEADER", "DEADLINE_HEADER",
+    "SHED_CLASS_HEADER", "SCHED_HEADERS",
+    "Autoscaler", "ReplicaSetActuator", "fleet_signals",
+    "TrafficModel", "OpenLoopRunner", "Arrival", "attainment",
+]

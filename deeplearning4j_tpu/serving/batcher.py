@@ -96,15 +96,20 @@ def next_bucket(n: int, max_batch: int, min_batch: int = 1) -> int:
 
 
 class _Ticket:
-    __slots__ = ("feats", "rows", "key", "future", "t_submit", "trace_id")
+    __slots__ = ("feats", "rows", "key", "future", "t_submit", "trace_id",
+                 "priority")
 
-    def __init__(self, feats, rows, key, trace_id=None):
+    def __init__(self, feats, rows, key, trace_id=None, priority=0):
         self.feats = feats
         self.rows = rows
         self.key = key
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.trace_id = trace_id
+        # strict-priority tier (scheduling/core.py PRIORITY: 0 =
+        # interactive, higher = sheds/waits first); the device thread
+        # seeds each bucket from the oldest highest-tier ticket
+        self.priority = priority
 
 
 def _trace_ids(batch) -> list:
@@ -228,19 +233,22 @@ class MicroBatcher:
                 self._thread = None
 
     # --------------------------------------------------------------- enqueue
-    def submit(self, feats: list, trace_id: str = None) -> Future:
+    def submit(self, feats: list, trace_id: str = None,
+               priority: int = 0) -> Future:
         """Enqueue one request (``feats``: list of arrays, one per model
         input, equal leading row counts <= max_batch). Returns a Future
         resolving to the model output sliced back to this ticket's rows.
         ``trace_id`` (the client's ``X-DL4J-Trace-Id``) rides the ticket
         onto the queue_wait/batch_assembly/device_compute span attrs so
-        server spans correlate with client-side spans."""
+        server spans correlate with client-side spans. ``priority`` is
+        the strict-priority tier (scheduling/core.py: 0 = interactive);
+        a lower number is dequeued first, FIFO within a tier."""
         rows = int(feats[0].shape[0])
         if rows > self.max_batch:
             raise ValueError(f"ticket of {rows} rows > max_batch "
                              f"{self.max_batch} — chunk before submit")
         key = tuple(tuple(f.shape[1:]) for f in feats)
-        t = _Ticket(feats, rows, key, trace_id)
+        t = _Ticket(feats, rows, key, trace_id, priority=int(priority))
         with self._cond:
             if not self.healthy:
                 raise BatcherDeadError("device thread is dead")
@@ -257,11 +265,30 @@ class MicroBatcher:
         return t.future
 
     # ----------------------------------------------------------- device side
+    def _seed_locked(self) -> _Ticket:
+        """The next ticket to anchor a device forward: the OLDEST
+        ticket of the HIGHEST priority tier present (strict priority,
+        FIFO within a tier) — an interactive request never waits behind
+        a batch backlog that arrived first. The scan is oldest-first
+        and exits at the first tier-0 ticket, so the default regime
+        (everything tier 0) stays the O(1) popleft it always was."""
+        best = None
+        for t in self._pending:
+            if best is None or t.priority < best.priority:
+                best = t
+                if best.priority <= 0:
+                    break
+        if best is self._pending[0]:
+            return self._pending.popleft()
+        self._pending.remove(best)
+        return best
+
     def _gather_locked(self):
-        """Pop the oldest ticket plus every later compatible ticket that
-        fits in the bucket; linger up to batch_window_ms for stragglers
-        when the bucket is not full. Called with the lock held."""
-        batch = [self._pending.popleft()]
+        """Pop the seed ticket (oldest, highest tier) plus every
+        compatible ticket that fits in the bucket; linger up to
+        batch_window_ms for stragglers when the bucket is not full.
+        Called with the lock held."""
+        batch = [self._seed_locked()]
         rows = batch[0].rows
         key = batch[0].key
 

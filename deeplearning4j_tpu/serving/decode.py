@@ -347,12 +347,19 @@ class DecodeEngine:
                  request_timeout_s: float = 300.0,
                  prefix_sharing: Optional[bool] = None,
                  prefill_chunk_pages: Optional[int] = None,
-                 speculative: Optional[int] = None, draft_net=None):
+                 speculative: Optional[int] = None, draft_net=None,
+                 scheduler=None):
         self.forward = StreamingKVForward(net)
+        # decode session scheduling rides the unified admission core
+        # (scheduling/core.py) when one is passed: decode ops submit at
+        # the interactive tier by construction (a live token stream IS
+        # interactive traffic), so under overload the fleet sheds
+        # co-resident batch prefill/predict work first
+        self.scheduler = scheduler
         self.fleet = ReplicaSet(self.forward, replicas, max_batch=max_batch,
                                 batch_window_ms=batch_window_ms,
                                 max_queue=max_queue, min_batch=min_batch,
-                                stats=stats)
+                                stats=stats, scheduler=scheduler)
         if prefix_sharing is None:
             prefix_sharing = os.environ.get(
                 "DL4J_TPU_KV_PREFIX_SHARING", "1").lower() \

@@ -7,9 +7,13 @@ the batcher exactly as it is — one bounded queue, one device thread,
 one coalesced bucket forward — and scales it horizontally:
 
 - **Admission** is global: ``submit`` rejects with ``QueueFullError``
-  once the SUM of per-replica queue depths reaches ``max_queue``, so
-  backpressure (503 + Retry-After) reflects fleet capacity, not
-  whichever replica a request happened to hash to.
+  once the SUM of LIVE replicas' queue depths reaches ``max_queue``
+  (a dead-but-unswept replica's stranded tickets are not capacity),
+  so backpressure (503 + Retry-After) reflects fleet capacity, not
+  whichever replica a request happened to hash to. With a
+  ``scheduler`` (scheduling/core.py) attached, admission runs the
+  unified class/quota/deadline discipline instead, and the admitted
+  class rides each ticket as its strict-priority tier.
 - **Routing** is by observed load: each ticket goes to the live replica
   with the shallowest queue (ties rotate round-robin) — the same
   measured-not-modeled scheduling stance as TVM's cost-model-free
@@ -94,11 +98,16 @@ class ReplicaSet:
 
     def __init__(self, forward, n: int = 1, *, max_batch: int = 1024,
                  batch_window_ms: float = 2.0, max_queue: int = 1024,
-                 min_batch: int = 2, stats=None, forwards=None):
+                 min_batch: int = 2, stats=None, forwards=None,
+                 scheduler=None):
         if forwards is None:
             forwards = [forward] * int(n)
         self.max_queue = int(max_queue)
         self.stats = stats
+        #: scheduling.core.SchedulingCore — when set, admission runs
+        #: class watermarks / tenant quotas / deadline sheds through it
+        #: (None keeps the legacy single-threshold reject exactly)
+        self.scheduler = scheduler
         self.shapes_seen: set[int] = set()
         self._batcher_cfg = dict(max_batch=max_batch,
                                  batch_window_ms=batch_window_ms,
@@ -178,6 +187,17 @@ class ReplicaSet:
 
     def total_depth(self) -> int:
         return sum(r.depth for r in self.replicas)
+
+    def live_depth(self) -> int:
+        """Backlog that can still DRAIN: queue depths of replicas whose
+        device thread is alive (live or draining). A dead-but-unswept
+        replica's stranded tickets are about to be failed by ``_die`` /
+        requeued — counting them against ``max_queue`` inflated rejects
+        right after an eviction, bouncing traffic the survivors had
+        room for. This is the admission-control depth; ``total_depth``
+        stays the observable-truth gauge."""
+        return sum(r.depth for r in self.replicas
+                   if r.status != DEAD and r.batcher.healthy)
 
     @property
     def degraded(self) -> bool:
@@ -346,26 +366,55 @@ class ReplicaSet:
             self._affinity.pop(session, None)
 
     def submit(self, feats: list, trace_id: str = None,
-               session=None) -> Future:
+               session=None, klass=None, tenant=None,
+               deadline_ms=None) -> Future:
         """Admit one ticket fleet-wide and route it to the shallowest
         live queue — or, with ``session=``, to the session's pinned
-        replica while it stays live. Raises ``QueueFullError`` when the
-        SUM of replica depths is at ``max_queue`` (global backpressure),
-        and ``BatcherDeadError`` only when no live replica remains."""
+        replica while it stays live. Admission counts only LIVE
+        replicas' depths (a dead-but-unswept replica's stranded queue
+        is not capacity the survivors owe anyone). With a
+        ``scheduler`` attached, admission runs the unified discipline
+        (scheduling/core.py): per-tenant quotas, class watermarks
+        (batch sheds at 50% of ``max_queue``, interactive only at
+        100% — the legacy threshold), and deadline sheds against the
+        derived wait estimate; the admitted class rides the batcher
+        ticket as its strict-priority tier. Raises ``QueueFullError``
+        (or its ``ShedError`` subclass) on reject, and
+        ``BatcherDeadError`` only when no live replica remains."""
         self.start()
-        if self.total_depth() >= self.max_queue:
+        depth = self.live_depth()
+        priority = 0
+        if self.scheduler is not None:
+            # the wait estimate feeds ONLY the deadline shed — skip the
+            # drain-rate scan (O(window) under the stats lock) for the
+            # deadline-less fast path
+            wait = self.stats.retry_after_s(depth) \
+                if deadline_ms is not None and self.stats is not None \
+                else None
+            try:
+                k = self.scheduler.admit(
+                    tenant=tenant, klass=klass, deadline_ms=deadline_ms,
+                    rows=int(feats[0].shape[0]), depth=depth,
+                    capacity=self.max_queue, wait_estimate_s=wait)
+            except QueueFullError:
+                if self.stats is not None:
+                    self.stats.record_rejected()
+                raise
+            priority = self.scheduler.PRIORITY[k]
+        elif depth >= self.max_queue:
             if self.stats is not None:
                 self.stats.record_rejected()
             raise QueueFullError(
-                f"{self.total_depth()} tickets pending across "
+                f"{depth} tickets pending across "
                 f"{len(self.replicas)} replicas (max_queue="
                 f"{self.max_queue})")
         outer = Future()
-        self._dispatch(feats, trace_id, outer, first=True, session=session)
+        self._dispatch(feats, trace_id, outer, first=True, session=session,
+                       priority=priority)
         return outer
 
     def _dispatch(self, feats, trace_id, outer: Future, first: bool,
-                  session=None):
+                  session=None, priority: int = 0):
         while True:
             r = self._pick(session)
             if r is None:
@@ -376,7 +425,7 @@ class ReplicaSet:
                 return
             b = r.batcher
             try:
-                inner = b.submit(feats, trace_id)
+                inner = b.submit(feats, trace_id, priority=priority)
             except BatcherDeadError:
                 # lost the race with a dying device thread — evict and
                 # try the next live replica
@@ -404,11 +453,11 @@ class ReplicaSet:
                 return
             inner.add_done_callback(
                 lambda f, rep=r: self._on_done(f, rep, feats, trace_id,
-                                               outer, session))
+                                               outer, session, priority))
             return
 
     def _on_done(self, inner: Future, replica: Replica, feats, trace_id,
-                 outer: Future, session=None):
+                 outer: Future, session=None, priority: int = 0):
         exc = inner.exception()
         if exc is None:
             outer.set_result(inner.result())  # analysis: ok(C003) — done-callback: future already resolved
@@ -421,6 +470,6 @@ class ReplicaSet:
             with self._lock:
                 self.requeued += 1
             self._dispatch(feats, trace_id, outer, first=False,
-                           session=session)
+                           session=session, priority=priority)
         else:
             outer.set_exception(exc)

@@ -100,6 +100,7 @@ from deeplearning4j_tpu.observability.distributed import (HeartbeatPusher,
                                                           TRACE_HEADER,
                                                           TraceStore,
                                                           new_trace_id)
+from deeplearning4j_tpu.scheduling import core as _sched
 
 __all__ = ["FrontDoorRouter", "HostHandle", "NoHostsError",
            "BACKEND_HEADER"]
@@ -213,10 +214,25 @@ class FrontDoorRouter:
                  request_timeout_s: float = 120.0,
                  federation: Optional[MetricsFederation] = None,
                  push_url: Optional[str] = None,
-                 push_interval_s: float = 2.0):
+                 push_interval_s: float = 2.0,
+                 scheduler=None, sched_capacity: Optional[int] = None):
         self.host = host
         self.port = port
         self.request_timeout_s = float(request_timeout_s)
+        #: front-door admission (SERVING.md §Traffic engine): tenant
+        #: quotas and deadline sheds run HERE, before a doomed request
+        #: costs a backend round trip; class watermarks run here too
+        #: when ``sched_capacity`` (aggregate queue bound) is set,
+        #: otherwise the hosts' own schedulers enforce them. Default
+        #: SchedulingCore = no quotas, so legacy traffic is untouched;
+        #: scheduler=False disables front-door admission entirely.
+        if scheduler is False:
+            self.scheduler = None
+        elif scheduler is None:
+            self.scheduler = _sched.SchedulingCore()
+        else:
+            self.scheduler = scheduler
+        self.sched_capacity = sched_capacity
         self.federation = federation if federation is not None else \
             MetricsFederation(stale_after_s=stale_after_s)
         #: auto-eviction threshold as a multiple of the federation's
@@ -628,9 +644,11 @@ class FrontDoorRouter:
 
     # ---------------------------------------------------------------- proxy
     def _proxy(self, h: HostHandle, path: str, body: bytes,
-               trace_id: str):
+               trace_id: str, headers=None):
         """One request/reply over the host's pooled connection. Raises
-        ``_HostDown`` on any connection-level failure. Every hop's
+        ``_HostDown`` on any connection-level failure. ``headers``
+        carries the end-to-end scheduling headers (tenant / priority /
+        deadline) hop to hop, exactly like the trace id. Every hop's
         [send, recv] window lands in the trace store on the router's
         own clock — the anchors the stitcher rebases every remote
         instance's spans against (a dead hop records with no status:
@@ -638,9 +656,11 @@ class FrontDoorRouter:
         conn = h.acquire()  # analysis: ok(C001) — pooled connection, not a lock; released/discarded below
         send_unix = time.time()
         try:
-            conn.request("POST", path, body,
-                         {"Content-Type": "application/json",
-                          TRACE_HEADER: trace_id})
+            hdrs = {"Content-Type": "application/json",
+                    TRACE_HEADER: trace_id}
+            if headers:
+                hdrs.update(headers)
+            conn.request("POST", path, body, hdrs)
             resp = conn.getresponse()
             data = resp.read()
             retry_after = resp.getheader("Retry-After")
@@ -658,9 +678,10 @@ class FrontDoorRouter:
             raise _HostDown(f"{h.base_url}: {type(e).__name__}: {e}")
 
     def _route(self, path: str, body: bytes, trace_id: str,
-               pick_fn) -> tuple:
+               pick_fn, headers=None, shed_klass=None) -> tuple:
         """Pick -> proxy -> on host death evict + retry on a survivor;
-        on fleet-wide 503, shed with the aggregated Retry-After.
+        on fleet-wide 503, shed with the aggregated Retry-After (and
+        the shed class, accounted per class in the scheduler).
         Returns (status, payload bytes, headers list)."""
         tried: List[HostHandle] = []
         retry_afters: List[float] = []
@@ -670,7 +691,8 @@ class FrontDoorRouter:
                 break
             h.enter()
             try:
-                status, data, ra = self._proxy(h, path, body, trace_id)
+                status, data, ra = self._proxy(h, path, body, trace_id,
+                                               headers)
             except _HostDown:
                 self._evict(h)
                 tried.append(h)
@@ -693,21 +715,66 @@ class FrontDoorRouter:
         if tried:
             with self._lock:
                 self.shed_total += 1
+            k = _sched.normalize_class(shed_klass)
+            if self.scheduler is not None:
+                self.scheduler.record_shed(k)
             ra = self._min_retry_after(retry_afters)
             return (503,
                     json.dumps({"error": "all hosts overloaded or "
                                          "unreachable"}).encode(),
-                    [("Retry-After", f"{ra:g}")], None)
+                    [("Retry-After", f"{ra:g}"),
+                     (_sched.SHED_CLASS_HEADER, k)], None)
         raise NoHostsError("no routable backend hosts")
 
+    def _front_door_admit(self, sched) -> Optional[tuple]:
+        """Tentpole: run the scheduler BEFORE any backend round trip.
+        Quota and deadline sheds are decided entirely from router-local
+        state (token buckets; the min pushed retry_after_s as the wait
+        estimate), so a doomed request costs nothing downstream. The
+        class watermark runs here only when ``sched_capacity`` gives
+        the router an aggregate queue bound — otherwise the hosts'
+        own schedulers enforce it against their real capacity. Returns
+        a (status, body, headers) 503 triple on shed, None on admit."""
+        if self.scheduler is None:
+            return None
+        sched = sched or {}
+        depth = capacity = None
+        if self.sched_capacity:
+            capacity = self.sched_capacity
+            depth = sum(int(r.get("queue_depth") or 0)
+                        for r in self._fed_rows().values() if r["live"])
+        wait = None
+        if sched.get("deadline_ms") is not None:
+            wait = self._min_retry_after([])
+        try:
+            self.scheduler.admit(
+                tenant=sched.get("tenant"), klass=sched.get("klass"),
+                deadline_ms=sched.get("deadline_ms"),
+                depth=depth, capacity=capacity, wait_estimate_s=wait)
+        except _sched.ShedError as e:
+            with self._lock:
+                self.shed_total += 1
+            ra = self._min_retry_after([])
+            return (503, json.dumps({"error": f"overloaded: {e}"}).encode(),
+                    [("Retry-After", f"{ra:g}"),
+                     (_sched.SHED_CLASS_HEADER, e.klass)])
+        return None
+
     # ------------------------------------------------------------- endpoints
-    def handle_predict(self, body: bytes, trace_id: str) -> tuple:
+    def handle_predict(self, body: bytes, trace_id: str,
+                       sched=None) -> tuple:
+        shed = self._front_door_admit(sched)
+        if shed is not None:
+            return shed
         with self._lock:
             self.requests_total += 1
         return self._route("/predict", body, trace_id,
-                           self._pick_canary_admitted)[:3]
+                           self._pick_canary_admitted,
+                           headers=_sched.build_sched_headers(sched),
+                           shed_klass=(sched or {}).get("klass"))[:3]
 
-    def handle_decode(self, payload: dict, trace_id: str) -> tuple:
+    def handle_decode(self, payload: dict, trace_id: str,
+                      sched=None) -> tuple:
         """Session-affine proxy for the host /decode protocol. The
         router owns the canonical token history; the host request
         always carries it, so ANY host can serve the step by
@@ -720,6 +787,14 @@ class FrontDoorRouter:
                 {"error": "decode payload needs op "
                           "(prefill|step|generate|close) and sid"})
                 .encode(), [])
+        fwd = _sched.build_sched_headers(sched)
+        sk = (sched or {}).get("klass")
+        if op != "close":
+            # close is cleanup, never shed — a quota-exhausted tenant
+            # must still be able to release its pool pages
+            shed = self._front_door_admit(sched)
+            if shed is not None:
+                return shed
         if op == "prefill":
             ids = [int(i) for i in payload.get("ids") or ()]
             if not ids:
@@ -732,7 +807,8 @@ class FrontDoorRouter:
             status, data, headers, _ = self._route(
                 "/decode", body, trace_id,
                 lambda tried: (self._pick_affine(sid) if not tried
-                               else self._pick(exclude=tried)))
+                               else self._pick(exclude=tried)),
+                headers=fwd, shed_klass=sk)
             return status, data, headers
         if op == "close":
             # broadcast to EVERY live host, not just the pinned one: a
@@ -795,8 +871,9 @@ class FrontDoorRouter:
             body = json.dumps({
                 "op": "generate", "sid": sid, "ids": ids,
                 "n_tokens": int(payload.get("n_tokens", 0))}).encode()
-            status, data, headers, _ = self._route("/decode", body,
-                                                   trace_id, gpick)
+            status, data, headers, _ = self._route(
+                "/decode", body, trace_id, gpick,
+                headers=fwd, shed_klass=sk)
             if status == 200:
                 toks = json.loads(data.decode() or "{}").get("tokens") \
                     or ()
@@ -830,8 +907,9 @@ class FrontDoorRouter:
 
         body = json.dumps({"op": "step", "sid": sid, "token": token,
                            "ids": history}).encode()
-        status, data, headers, _ = self._route("/decode", body,
-                                               trace_id, pick)
+        status, data, headers, _ = self._route("/decode", body, trace_id,
+                                               pick, headers=fwd,
+                                               shed_klass=sk)
         if status == 200:
             # history grows only on a confirmed reply: a retried lost
             # reply re-sends the SAME history, so the survivor's
@@ -841,6 +919,34 @@ class FrontDoorRouter:
                 if hist is not None:
                     hist.append(token)
         return status, data, headers
+
+    def handle_hosts(self, payload: dict) -> tuple:
+        """POST /api/hosts — topology as an HTTP verb, symmetric with
+        eviction: ``{"url": ..., "action": "add"}`` registers a backend
+        (the autoscaler's cross-host actuator calls this after the
+        launcher boots a warm child), ``"evict"`` removes one. The next
+        /api/fleet scrape reflects the change — the routing table and
+        the federation scoreboard are both derived, not cached."""
+        url = str(payload.get("url") or "").rstrip("/")
+        action = payload.get("action") or "add"
+        if not url or action not in ("add", "evict"):
+            return 400, {"error": "needs url and action (add|evict)"}
+        if action == "add":
+            existing = next((h for h in self.hosts
+                             if h.base_url == url and h.status == LIVE),
+                            None)
+            added = existing is None
+            if added:
+                self.add_host(url)
+            return 200, {"ok": True, "action": "add", "url": url,
+                         "added": added, "hosts": len(self.hosts)}
+        target = next((h for h in self.hosts
+                       if h.base_url == url and h.status == LIVE), None)
+        if target is not None:
+            self._evict(target)
+        return 200, {"ok": True, "action": "evict", "url": url,
+                     "evicted": target is not None,
+                     "hosts": len(self.hosts)}
 
     # ----------------------------------------------------------------- state
     def route_table(self) -> List[dict]:
@@ -889,6 +995,8 @@ class FrontDoorRouter:
                 "promotions_total": self.promotions_total,
                 "quarantined": sorted(h.base_url
                                       for h in self._quarantined),
+                "sched": (self.scheduler.snapshot()
+                          if self.scheduler is not None else None),
             }
 
     def healthz(self) -> tuple:
@@ -958,6 +1066,10 @@ class FrontDoorRouter:
             # renders attainment / burn-rate / budget-remaining
             self.slo_engine.ingest_fed_rows(self.federation.health())
             fams.extend(self.slo_engine.families())
+            # front-door scheduler families (dl4j_sched_*) — the
+            # router-side view of quota/class/deadline sheds
+            if self.scheduler is not None:
+                fams.extend(self.scheduler.metric_families(L))
             return fams
 
         reg = _obs_metrics.get_registry()
@@ -1025,16 +1137,30 @@ class FrontDoorRouter:
             def do_POST(self):  # noqa: N802
                 trace_id = (self.headers.get(TRACE_HEADER)
                             or new_trace_id())
-                echo = ((TRACE_HEADER, trace_id),)
+                sched = _sched.parse_sched_headers(self.headers)
+                # echo the scheduling headers back like the trace id —
+                # the client sees the normalized class it was admitted
+                # (or shed) as, plus its own tenant/deadline
+                echo = ((TRACE_HEADER, trace_id),
+                        (_sched.PRIORITY_HEADER, sched["klass"]))
+                if sched["tenant"]:
+                    echo += ((_sched.TENANT_HEADER, sched["tenant"]),)
+                if sched["deadline_ms"] is not None:
+                    echo += ((_sched.DEADLINE_HEADER,
+                              f"{sched['deadline_ms']:g}"),)
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 try:
                     if self.path.startswith("/predict"):
                         code, data, hdrs = router.handle_predict(
-                            body, trace_id)
+                            body, trace_id, sched)
                     elif self.path.startswith("/decode"):
                         code, data, hdrs = router.handle_decode(
-                            json.loads(body.decode()), trace_id)
+                            json.loads(body.decode()), trace_id, sched)
+                    elif self.path.startswith("/api/hosts"):
+                        code, obj = router.handle_hosts(
+                            json.loads(body.decode() or "{}"))
+                        data, hdrs = json.dumps(obj).encode(), []
                     elif self.path.startswith("/api/metrics_push"):
                         snap = json.loads(body.decode())
                         tag = router.federation.ingest(snap)

@@ -95,7 +95,7 @@ class ModelServer:
                  compile_cache_dir=None, aot_manifest=None,
                  tuning_report=None, decode_engine=None,
                  push_url=None, push_interval_s: float = 2.0,
-                 slos=None):
+                 slos=None, scheduler=None):
         from deeplearning4j_tpu.compilecache import cache as _ccache
         # Cold-start engine (SERVING.md "Cold start & AOT"):
         # - compile_cache_dir (or $DL4J_TPU_COMPILE_CACHE) activates the
@@ -172,12 +172,27 @@ class ModelServer:
                 min_batch = max(min_batch, int(mesh.shape[data_axis]))
         else:
             forward = self._device_forward
+        # SLO-aware admission (SERVING.md §Traffic engine): on by
+        # default with no quotas configured — class watermarks degrade
+        # batch first under backpressure while default-class traffic
+        # keeps the legacy reject threshold exactly; scheduler=False
+        # disables (the bench.py sched_overhead off-arm), an explicit
+        # SchedulingCore customizes quotas/watermarks.
+        if scheduler is False:
+            self.scheduler = None
+        elif scheduler is None:
+            from deeplearning4j_tpu.scheduling.core import SchedulingCore
+            self.scheduler = SchedulingCore()
+        else:
+            self.scheduler = scheduler
+        self._sched_collector = None
         # N batcher workers behind one admission queue (serving/fleet.py)
         # — replicas=1 degenerates to the single-batcher seed behavior
         self._fleet = ReplicaSet(
             forward, int(replicas), max_batch=max_batch,
             batch_window_ms=batch_window_ms, max_queue=max_queue,
-            min_batch=min_batch, stats=self.stats)
+            min_batch=min_batch, stats=self.stats,
+            scheduler=self.scheduler)
         # every distinct padded batch shape handed to the device (warm-up
         # ladder included) — the compile count is bounded by
         # len(shapes_seen) (asserted by the serving concurrency test);
@@ -447,16 +462,20 @@ class ModelServer:
         return None if s is None else [s]
 
     # ------------------------------------------------------------ inference
-    def predict(self, features, trace_id=None):
+    def predict(self, features, trace_id=None, klass=None, tenant=None,
+                deadline_ms=None):
         """Enqueue the request into the micro-batcher and wait for the
         scattered result rows. Requests larger than ``max_batch`` are
         split into ``max_batch`` chunks so they reuse the already-compiled
         full-bucket program instead of compiling a fresh XLA executable of
         arbitrary shape. ``features``: one array (sequential net) or list
         of arrays (graph). ``trace_id`` propagates onto the batcher span
-        attrs (the HTTP handler passes the client's ``X-DL4J-Trace-Id``).
-        Raises QueueFullError when admission control rejects (mapped to
-        HTTP 503)."""
+        attrs (the HTTP handler passes the client's ``X-DL4J-Trace-Id``);
+        ``klass`` / ``tenant`` / ``deadline_ms`` are the scheduling
+        headers (X-DL4J-Priority / -Tenant / -Deadline-Ms) threaded into
+        fleet admission the same way. Raises QueueFullError (or its
+        ShedError subclass naming the shed class) when admission control
+        rejects (mapped to HTTP 503)."""
         t0 = time.perf_counter()
         many = isinstance(features, (list, tuple))
         if many and not self._is_graph and len(features) != 1:
@@ -472,7 +491,8 @@ class ModelServer:
         self._fleet.start()  # idempotent; lazy for direct predict() use
         futures = [self._fleet.submit(
                        [f[i:i + self.max_batch] for f in feats],
-                       trace_id=trace_id)
+                       trace_id=trace_id, klass=klass, tenant=tenant,
+                       deadline_ms=deadline_ms)
                    for i in range(0, max(n, 1), self.max_batch)]
         # one deadline for the whole request, not per chunk: the budget
         # left after chunk k is what chunk k+1 may spend
@@ -708,9 +728,20 @@ class ModelServer:
                 # so the client can stitch both timelines together
                 from deeplearning4j_tpu.observability import \
                     distributed as _dist
+                from deeplearning4j_tpu.scheduling import core as _sched
                 trace_id = (self.headers.get(_dist.TRACE_HEADER)
                             or _dist.new_trace_id())
                 echo = ((_dist.TRACE_HEADER, trace_id),)
+                # scheduling-context propagation, same contract: the
+                # tenant/priority/deadline headers thread into fleet
+                # admission and echo back normalized
+                sched = _sched.parse_sched_headers(self.headers)
+                echo += ((_sched.PRIORITY_HEADER, sched["klass"]),)
+                if sched["tenant"]:
+                    echo += ((_sched.TENANT_HEADER, sched["tenant"]),)
+                if sched["deadline_ms"] is not None:
+                    echo += ((_sched.DEADLINE_HEADER,
+                              f"{sched['deadline_ms']:g}"),)
                 # one handler span per request, trace-tagged and
                 # carrying server_url — the span the aggregator's
                 # TraceStore centers inside the router's send/recv hop
@@ -719,9 +750,11 @@ class ModelServer:
                 with _obs_trace.get_tracer().span(
                         "decode_op" if is_decode else "predict_handler",
                         trace_id=trace_id, server_url=server.url):
-                    self._handle_post(is_decode, trace_id, echo)
+                    self._handle_post(is_decode, trace_id, echo, sched)
 
-            def _handle_post(self, is_decode, trace_id, echo):
+            def _handle_post(self, is_decode, trace_id, echo, sched):
+                from deeplearning4j_tpu.scheduling.core import (
+                    SHED_CLASS_HEADER, ShedError)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n).decode())
@@ -733,10 +766,10 @@ class ModelServer:
                     if "inputs" in payload:
                         out = server.predict([np.asarray(a) for a in
                                               payload["inputs"]],
-                                             trace_id=trace_id)
+                                             trace_id=trace_id, **sched)
                     else:
                         out = server.predict(np.asarray(payload["features"]),
-                                             trace_id=trace_id)
+                                             trace_id=trace_id, **sched)
                     if isinstance(out, list):
                         preds = [np.asarray(o).tolist() for o in out]
                     else:
@@ -746,11 +779,21 @@ class ModelServer:
                     # backpressure: shed load instead of growing the
                     # queue. Retry-After is DERIVED: current backlog over
                     # the observed drain rate, clamped to [0.05s, 5s] —
-                    # a fast-draining fleet calls clients back sooner
+                    # a fast-draining fleet calls clients back sooner.
+                    # X-DL4J-Shed-Class names WHICH class was shed (the
+                    # ShedError knows; a legacy full-queue reject sheds
+                    # the request's own class) so load tests can verify
+                    # batch sheds before interactive.
+                    shed_k = e.klass if isinstance(e, ShedError) \
+                        else sched["klass"]
+                    if not isinstance(e, ShedError) \
+                            and server.scheduler is not None:
+                        server.scheduler.record_shed(shed_k)
                     self._json({"error": f"overloaded: {e}"}, 503,
                                headers=(("Retry-After",
                                          f"{server.stats.retry_after_s():g}"
-                                         ),) + echo)
+                                         ),
+                                        (SHED_CLASS_HEADER, shed_k)) + echo)
                 except BatcherDeadError as e:
                     # dead device thread: same 503 the health check gives
                     self._json({"error": f"unhealthy: {e}"}, 503,
@@ -777,6 +820,7 @@ class ModelServer:
         self._attach_fleet_collector()
         self._attach_decode_collector()
         self._attach_slo_collector()
+        self._attach_sched_collector()
         self._ledger = _goodput.start_run("serving", net=self.net)
         self._ledger.rebase_compile(compile0)
         if self.warmup_s is not None:
@@ -836,6 +880,8 @@ class ModelServer:
         snap["requeued_total"] = self._fleet.requeued
         snap["model_version"] = self.model_version
         snap["weight_swaps_total"] = self.swaps_total
+        if self.scheduler is not None:
+            snap["sched"] = self.scheduler.snapshot()
         if self.decode_engine is not None:
             snap["decode"] = self.decode_engine.describe()
         return snap
@@ -922,6 +968,23 @@ class ModelServer:
         reg.register_collector(_collect)
         self._slo_collector = (reg, _collect)
 
+    def _attach_sched_collector(self):
+        """``dl4j_sched_*`` families (per-class admitted/shed counters,
+        per-tenant quota-token gauges) on the unified registry — the
+        satellite contract that lets a load test watch batch shed while
+        interactive is admitted. Federation pushes read the same
+        registry, so the router sees these series for free."""
+        if self.scheduler is None:
+            return
+        addr = f"{self.host}:{self.port}"
+
+        def _collect():
+            return self.scheduler.metric_families({"server": addr})
+
+        reg = _obs_metrics.get_registry()
+        reg.register_collector(_collect)
+        self._sched_collector = (reg, _collect)
+
     def stop(self):
         """Stop accepting, then drain: every accepted ticket completes
         before the device thread exits. Closes the serving goodput
@@ -952,6 +1015,10 @@ class ModelServer:
             reg, collect = self._slo_collector
             reg.unregister_collector(collect)
             self._slo_collector = None
+        if self._sched_collector is not None:
+            reg, collect = self._sched_collector
+            reg.unregister_collector(collect)
+            self._sched_collector = None
         ledger = getattr(self, "_ledger", None)
         if ledger is not None and self.slo_engine is not None:
             # final ingest + stamp: the drain report carries the run's
@@ -978,7 +1045,8 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
           model_axis: str = "model", data_axis=None,
           tp_rules=None, compile_cache_dir=None, aot_manifest=None,
           tuning_report=None, decode_engine=None, push_url=None,
-          push_interval_s: float = 2.0, slos=None) -> ModelServer:
+          push_interval_s: float = 2.0, slos=None,
+          scheduler=None) -> ModelServer:
     """One-call serving entry point: ``serve(net).url`` is live."""
     return ModelServer(net, host, port, max_batch,
                        batch_window_ms=batch_window_ms, max_queue=max_queue,
@@ -992,4 +1060,4 @@ def serve(net, host: str = "127.0.0.1", port: int = 9500,
                        tuning_report=tuning_report,
                        decode_engine=decode_engine, push_url=push_url,
                        push_interval_s=push_interval_s,
-                       slos=slos).start()
+                       slos=slos, scheduler=scheduler).start()

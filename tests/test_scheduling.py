@@ -1,0 +1,420 @@
+"""SLO-aware traffic engine tests (SERVING.md §Traffic engine): the
+unified SchedulingCore contract (tenant quotas with an injectable
+clock, class watermarks degrading batch first, deadline sheds),
+strict-priority tiers beating a batch backlog at the batcher,
+live-only admission depth in the fleet, the shed-class header + shed
+counters on the HTTP wire, the router's /api/hosts topology verb and
+front-door quota isolation, the autoscaler's hysteresis / cooldown /
+bounds state machine, and the TRAFFIC budget gate (including a
+demonstrably-failing bound)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.scheduling.autoscaler import Autoscaler
+from deeplearning4j_tpu.scheduling.core import (
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    SHED_CLASS_HEADER,
+    TENANT_HEADER,
+    SchedulingCore,
+    ShedError,
+    build_sched_headers,
+    parse_sched_headers,
+)
+from deeplearning4j_tpu.serving.batcher import MicroBatcher, QueueFullError
+from deeplearning4j_tpu.serving.fleet import DEAD, ReplicaSet
+from deeplearning4j_tpu.serving.router import FrontDoorRouter
+from deeplearning4j_tpu.serving.server import ModelServer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+import check_budgets  # noqa: E402  (scripts/check_budgets.py)
+
+
+def _mlp(seed=1):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import Dense, Output
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(Dense(n_in=6, n_out=8, activation="relu"))
+            .layer(Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(url, path, obj, headers=None, timeout=60.0):
+    """POST returning (status, json_body, headers) — error replies
+    (4xx/5xx) come back the same way instead of raising, because the
+    point here is asserting on THEIR headers."""
+    req = urllib.request.Request(
+        url.rstrip("/") + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get_text(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+# ------------------------------------------------------ core: quotas
+
+
+def test_quota_exhaustion_tenant_isolation():
+    """Tenant A's exhausted token bucket sheds A — and ONLY A: an
+    unquota'd tenant B keeps admitting through the same core, and the
+    bucket refills on the injectable clock, not the wall clock."""
+    t = [0.0]
+    core = SchedulingCore(quotas={"a": (1.0, 2.0)}, clock=lambda: t[0])
+    assert core.admit(tenant="a") == "interactive"
+    assert core.admit(tenant="a") == "interactive"     # burst of 2
+    with pytest.raises(ShedError) as ei:
+        core.admit(tenant="a")
+    assert ei.value.reason == "quota"
+    assert isinstance(ei.value, QueueFullError)        # 503 mapping rides
+    # B is untouched by A's exhaustion
+    for _ in range(50):
+        core.admit(tenant="b", klass="batch")
+    # refill is clock-driven: +1s at 1/s buys exactly one more admit
+    t[0] = 1.0
+    core.admit(tenant="a")
+    with pytest.raises(ShedError):
+        core.admit(tenant="a")
+    snap = core.snapshot()
+    assert snap["shed_by_reason"]["interactive/quota"] == 2
+    assert snap["admitted_total"]["batch"] == 50
+
+
+def test_watermark_sheds_batch_before_interactive():
+    """The degradation order under backlog: best_effort sheds first
+    (25%), batch next (50%), interactive only at the legacy 100%."""
+    core = SchedulingCore()
+    kw = dict(depth=30, capacity=100)
+    with pytest.raises(ShedError):
+        core.admit(klass="best_effort", **kw)
+    assert core.admit(klass="batch", **kw) == "batch"
+    kw = dict(depth=60, capacity=100)
+    with pytest.raises(ShedError) as ei:
+        core.admit(klass="batch", **kw)
+    assert ei.value.reason == "backpressure"
+    assert core.admit(klass="interactive", **kw) == "interactive"
+    with pytest.raises(ShedError):
+        core.admit(klass="interactive", depth=100, capacity=100)
+    assert core.snapshot()["deepest_admitted_fraction"] == 0.6
+
+
+def test_deadline_shed_against_wait_estimate():
+    core = SchedulingCore()
+    with pytest.raises(ShedError) as ei:
+        core.admit(deadline_ms=500.0, wait_estimate_s=2.0)
+    assert ei.value.reason == "deadline"
+    assert core.admit(deadline_ms=5000.0, wait_estimate_s=2.0) \
+        == "interactive"
+
+
+def test_sched_header_parse_build_roundtrip():
+    sched = {"tenant": "acme", "klass": "batch", "deadline_ms": 1500.0}
+    hdrs = build_sched_headers(sched)
+    assert hdrs == {PRIORITY_HEADER: "batch", TENANT_HEADER: "acme",
+                    DEADLINE_HEADER: "1500"}
+    assert parse_sched_headers(hdrs) == sched
+    # header-less traffic is interactive with no tenant/deadline
+    assert parse_sched_headers({}) == {"tenant": None,
+                                       "klass": "interactive",
+                                       "deadline_ms": None}
+    # unknown class names degrade to the default, not an error
+    assert parse_sched_headers({PRIORITY_HEADER: "??"})["klass"] \
+        == "interactive"
+
+
+# --------------------------------------------- batcher: strict priority
+
+
+def test_interactive_jumps_batch_backlog():
+    """Priority inversion: an interactive ticket submitted AFTER five
+    batch tickets is the very next one served (strict priority, FIFO
+    within a tier) — it never waits out the backlog."""
+    gate = threading.Event()
+    order = []
+
+    def fwd(feats):
+        order.append(int(feats[0][0, 0]))
+        gate.wait(10)
+        return feats[0]
+
+    b = MicroBatcher(fwd, max_batch=1, batch_window_ms=0.0, max_queue=16)
+    b.start()
+    try:
+        def tik(marker):
+            return np.full((1, 2), marker, np.float32)
+
+        first = b.submit([tik(100)], priority=1)
+        deadline = time.time() + 5.0        # in flight, blocking on gate
+        while b.depth > 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert b.depth == 0
+        futs = [b.submit([tik(i)], priority=1) for i in range(1, 6)]
+        vip = b.submit([tik(42)], priority=0)
+        gate.set()
+        vip.result(timeout=10)
+        first.result(timeout=10)
+        for f in futs:
+            f.result(timeout=10)
+        assert order[0] == 100              # already on the device
+        assert order[1] == 42               # the queue-jump
+        assert order[2:] == [1, 2, 3, 4, 5]
+    finally:
+        gate.set()
+        b.stop()
+
+
+# ------------------------------------------- fleet: live-only admission
+
+
+def test_fleet_admission_counts_only_live_depth():
+    """Global backpressure over LIVE replicas only: a dead replica's
+    stranded queue stops counting against max_queue the moment it is
+    marked dead, so survivors keep admitting the room they have."""
+    gate = threading.Event()
+
+    def fwd(feats):
+        gate.wait(10)
+        return feats[0]
+
+    rs = ReplicaSet(fwd, n=2, max_batch=1, batch_window_ms=0.0,
+                    max_queue=4)
+    rs.start()
+    try:
+        x = np.ones((1, 2), np.float32)
+        inflight = [rs.submit([x]), rs.submit([x])]
+        deadline = time.time() + 5.0
+        while rs.total_depth() > 0 and time.time() < deadline:
+            time.sleep(0.01)                # both devices now blocked
+        queued = [rs.submit([x]) for _ in range(4)]   # depth 4 == cap
+        with pytest.raises(QueueFullError):
+            rs.submit([x])
+        rs.replicas[0].status = DEAD
+        assert rs.total_depth() == 4
+        assert rs.live_depth() == 2         # the stranded 2 drop out
+        extra = rs.submit([x])              # room again — no reject
+        gate.set()
+        for f in inflight + queued + [extra]:
+            f.result(timeout=10)
+    finally:
+        gate.set()
+        rs.stop()
+
+
+# --------------------------------------------- wire: shed-class header
+
+
+def test_shed_503_carries_class_header_and_counters():
+    """A quota shed through the real HTTP server answers 503 with
+    X-DL4J-Shed-Class + Retry-After, echoes the priority header on
+    the 200 path, and lands in the dl4j_sched_* families."""
+    sched = SchedulingCore(quotas={"acme": (0.0, 1.0)})
+    server = ModelServer(_mlp(), port=0, replicas=1, warmup=False,
+                         max_batch=4, scheduler=sched).start()
+    try:
+        body = {"features": [[0.1] * 6]}
+        st, _, h = _post(server.url, "/predict", body,
+                         headers={TENANT_HEADER: "acme"})
+        assert st == 200
+        assert h.get(PRIORITY_HEADER) == "interactive"
+        assert h.get(TENANT_HEADER) == "acme"
+        st, out, h = _post(server.url, "/predict", body,
+                           headers={TENANT_HEADER: "acme"})
+        assert st == 503
+        assert h.get(SHED_CLASS_HEADER) == "interactive"
+        assert float(h.get("Retry-After")) >= 0.05
+        assert "quota" in out["error"]
+        text = _get_text(server.url + "/metrics?format=prometheus")
+        assert 'dl4j_sched_shed_total{' in text
+        assert 'reason="quota"' in text
+        assert server.metrics()["sched"]["shed_total"]["interactive"] == 1
+    finally:
+        server.stop()
+
+
+# ------------------------------------------ router: /api/hosts + quota
+
+
+def test_router_hosts_verb_and_front_door_quota():
+    """POST /api/hosts is topology-as-a-verb (add is idempotent on a
+    live url, evict symmetric with auto-eviction), and the router's
+    front-door quota sheds the scraper tenant WITHOUT starving the
+    others — the scraper's 503s never reach a backend queue."""
+    router = FrontDoorRouter(
+        scheduler=SchedulingCore(quotas={"scraper": (0.0, 2.0)})).start()
+    server = ModelServer(_mlp(), port=0, replicas=1, warmup=False,
+                         max_batch=4).start()
+    try:
+        st, out, _ = _post(router.url, "/api/hosts",
+                           {"action": "add", "url": server.url})
+        assert st == 200 and out["added"] is True and out["hosts"] == 1
+        st, out, _ = _post(router.url, "/api/hosts",
+                           {"action": "add", "url": server.url})
+        assert out["added"] is False and out["hosts"] == 1   # idempotent
+        body = {"features": [[0.1] * 6]}
+        for _ in range(2):                  # the scraper's burst
+            st, _, h = _post(router.url, "/predict", body,
+                             headers={TENANT_HEADER: "scraper"})
+            assert st == 200
+        st, _, h = _post(router.url, "/predict", body,
+                         headers={TENANT_HEADER: "scraper"})
+        assert st == 503
+        assert h.get(SHED_CLASS_HEADER) == "interactive"
+        assert h.get("Retry-After") is not None
+        # the other tenant rides through untouched
+        st, out, h = _post(router.url, "/predict", body,
+                           headers={TENANT_HEADER: "acme",
+                                    PRIORITY_HEADER: "batch"})
+        assert st == 200 and len(out["predictions"]) == 1
+        assert h.get(PRIORITY_HEADER) == "batch"
+        snap = router.describe()["sched"]
+        assert snap["shed_by_reason"]["interactive/quota"] >= 1
+        st, out, _ = _post(router.url, "/api/hosts",
+                           {"action": "evict", "url": server.url})
+        assert st == 200 and out["evicted"] is True
+        st, out, _ = _post(router.url, "/api/hosts",
+                           {"action": "evict", "url": server.url})
+        assert out["evicted"] is False      # nothing live left to evict
+    finally:
+        router.stop()
+        server.stop()
+
+
+# ------------------------------------------------- autoscaler machine
+
+
+def test_autoscaler_hysteresis_cooldowns_and_bounds():
+    """The full decision walk on an injectable clock: breach_n arms
+    the scale-up (one breach is noise), last_reaction_s spans
+    breach-start to actuation, max_size holds further ups,
+    clear_n + down_cooldown gate the scale-down, min_size floors it."""
+    t = [0.0]
+    sig = {"queue_depth": 50.0, "size": 1}
+    ups, downs = [], []
+    a = Autoscaler(signals_fn=lambda: dict(sig),
+                   up=lambda: ups.append(t[0]) or True,
+                   down=lambda: downs.append(t[0]) or True,
+                   min_size=1, max_size=2, up_queue_depth=10.0,
+                   down_queue_depth=0.0, breach_n=3, clear_n=2,
+                   up_cooldown_s=5.0, down_cooldown_s=5.0,
+                   clock=lambda: t[0])
+    assert a.step()["decision"] == "hold"   # breach 1: noise
+    t[0] = 1.0
+    assert a.step()["decision"] == "hold"   # breach 2: still settling
+    t[0] = 2.0
+    d = a.step()                            # breach 3: armed -> up
+    assert d["decision"] == "up" and d["acted"] and ups == [2.0]
+    snap = a.snapshot()
+    assert snap["scale_ups_total"] == 1
+    assert snap["last_reaction_s"] == 2.0   # breach at t=0, act at t=2
+    sig["size"] = 2                         # the fleet reflects the add
+    for t[0] in (2.5, 3.0, 3.5):            # breached again immediately
+        d = a.step()
+    assert d["decision"] == "hold" and d["why"] == "at_max"
+    assert len(ups) == 1                    # bounds hold under breach
+    sig["queue_depth"] = 0.0                # load gone
+    t[0] = 6.0
+    assert a.step()["decision"] == "hold"   # clear 1
+    t[0] = 7.0
+    d = a.step()                            # clear 2 + cooldown elapsed
+    assert d["decision"] == "down" and downs == [7.0]
+    assert a.snapshot()["size"] == 1
+    sig["size"] = 1                         # the fleet reflects the drain
+    t[0] = 20.0
+    a.step()
+    d = a.step()
+    assert d["why"] == "at_min" and len(downs) == 1
+
+
+def test_autoscaler_up_cooldown_blocks_refire():
+    t = [0.0]
+    sig = {"queue_depth": 50.0}
+    ups = []
+    a = Autoscaler(signals_fn=lambda: dict(sig),
+                   up=lambda: ups.append(t[0]) or True,
+                   min_size=1, max_size=8, up_queue_depth=10.0,
+                   breach_n=1, up_cooldown_s=10.0, clock=lambda: t[0])
+    assert a.step()["decision"] == "up"
+    t[0] = 3.0
+    assert a.step()["why"] == "up_cooldown"
+    t[0] = 11.0
+    assert a.step()["decision"] == "up"     # cooldown elapsed
+    assert ups == [0.0, 11.0]
+
+
+# ---------------------------------------------------- the budget gate
+
+
+_GOOD_TRAFFIC = {
+    "config": "traffic",
+    "offered_over_sustainable": 2.9,
+    "attainment_interactive": 0.87,
+    "attainment_batch": 0.53,
+    "attainment_gap": 0.34,
+    "interactive_p99_ms": 1280.0,
+    "batch_sheds": 1200,
+    "quota_sheds": 700,
+    "scale_ups_total": 1,
+    "scaleup_reaction_s": 5.0,
+    "scaleup_fresh_compiles": 0,
+}
+
+
+def test_traffic_budget_bounds():
+    budgets = json.load(open(os.path.join(_REPO, "BUDGETS.json")))
+    assert check_budgets.check_report(_GOOD_TRAFFIC,
+                                      budgets["traffic"]) == []
+    # every bound must be demonstrably falsifiable
+    for key, bad in [("attainment_interactive", 0.5),
+                     ("attainment_gap", 0.01),
+                     ("interactive_p99_ms", 9000.0),
+                     ("offered_over_sustainable", 1.2),
+                     ("quota_sheds", 0),
+                     ("scaleup_fresh_compiles", 3),
+                     ("scaleup_reaction_s", 120.0)]:
+        doctored = dict(_GOOD_TRAFFIC, **{key: bad})
+        viol = check_budgets.check_report(doctored, budgets["traffic"])
+        assert viol, f"doctored {key}={bad} must violate"
+    # sched_overhead section rides the same gate
+    ok = {"config": "sched_overhead", "overhead_pct": 1.9}
+    assert check_budgets.check_report(ok, budgets["sched_overhead"]) == []
+    assert check_budgets.check_report(
+        {"config": "sched_overhead", "overhead_pct": 4.2},
+        budgets["sched_overhead"])
+
+
+def test_committed_traffic_receipt_passes_gate():
+    art = os.path.join(_REPO, "TRAFFIC_r01.json")
+    if not os.path.exists(art):
+        pytest.skip("TRAFFIC_r01.json not committed yet")
+    assert check_budgets.main(["--bench", art]) == 0
+
+
+def test_traffic_gate_fails_on_doctored_receipt(tmp_path, capsys):
+    art = os.path.join(_REPO, "TRAFFIC_r01.json")
+    if not os.path.exists(art):
+        pytest.skip("TRAFFIC_r01.json not committed yet")
+    doc = json.load(open(art))
+    doc["scaleup_fresh_compiles"] = 7       # a cold scale-up
+    bad = tmp_path / "doctored.json"
+    bad.write_text(json.dumps(doc))
+    assert check_budgets.main(["--bench", str(bad)]) == 1
+    assert "BUDGET VIOLATION" in capsys.readouterr().out
